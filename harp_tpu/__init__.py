@@ -1,0 +1,34 @@
+"""harp-tpu: a TPU-native distributed ML framework with the capabilities of Harp.
+
+Harp (Indiana University) plugged MPI-style collectives into Hadoop MapReduce for
+iterative distributed ML on Xeon clusters (Java + Intel DAAL native kernels). This
+framework re-expresses those capabilities idiomatically for TPU:
+
+* Harp's Table/Partition data model  → :mod:`harp_tpu.table` (dense sharded arrays
+  with distribution states) on a ``jax.sharding.Mesh``.
+* Harp's TCP collective runtime      → :mod:`harp_tpu.collectives` (XLA collectives
+  over ICI/DCN inside shard_map).
+* ``CollectiveMapper`` / HarpSession → :class:`harp_tpu.session.HarpSession`.
+* dymoro model rotation              → :mod:`harp_tpu.collectives.rotation`.
+* Intel DAAL kernels                 → :mod:`harp_tpu.ops` (jnp + pallas) and
+  :mod:`harp_tpu.models` (the algorithm library).
+* YARN gang scheduling               → :mod:`harp_tpu.parallel.distributed`.
+
+See SURVEY.md at the repo root for the full reference analysis and mapping.
+"""
+
+from harp_tpu import combiner
+from harp_tpu import partitioner
+from harp_tpu.combiner import AVG, MAX, MIN, MINUS, MULTIPLY, SUM, Combiner, Op
+from harp_tpu.parallel.mesh import MODEL, WORKERS, force_host_devices, make_mesh
+from harp_tpu.session import HarpSession
+from harp_tpu.table import Dist, Table
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "AVG", "MAX", "MIN", "MINUS", "MULTIPLY", "SUM",
+    "Combiner", "Op", "Dist", "Table", "HarpSession",
+    "WORKERS", "MODEL", "force_host_devices", "make_mesh",
+    "combiner", "partitioner",
+]

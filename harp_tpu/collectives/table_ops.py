@@ -1,0 +1,193 @@
+"""Table-level collectives — Harp's user-facing collective API, TPU-native.
+
+Reference parity: the instance methods on ``CollectiveMapper``
+(core/harp-hadoop/.../CollectiveMapper.java — broadcast:403, reduce:431,
+allgather:455, allreduce:479, regroup:505, pull:538, push:573, rotate:606) and the
+static classes in ``collective/``. Each op here is a distribution-state transition on
+a :class:`harp_tpu.table.Table` (see table.py docstring for the state model) that
+lowers to exactly one XLA collective.
+
+These functions run INSIDE an SPMD program (shard_map over the ``workers`` axis) —
+use :class:`harp_tpu.session.HarpSession` to enter one. Non-block partition→worker
+maps are handled by a static permutation of the partition axis (harp_tpu.partitioner):
+permute → block collective → (on gather) inverse-permute, so arbitrary Harp
+partitioners cost one local gather, never extra network.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from harp_tpu import combiner as combiner_lib
+from harp_tpu import partitioner as partitioner_lib
+from harp_tpu.collectives import lax_ops
+from harp_tpu.parallel.mesh import WORKERS
+from harp_tpu.table import Dist, Table
+
+
+def _perm_apply(data: jax.Array, perm) -> jax.Array:
+    import numpy as np
+
+    if perm is None or bool(np.all(np.asarray(perm) == np.arange(len(perm)))):
+        return data
+    return jnp.take(data, jnp.asarray(perm), axis=0)
+
+
+def allreduce(t: Table, axis_name: str = WORKERS) -> Table:
+    """LOCAL → REPLICATED: combine per-worker contributions partition-wise.
+
+    Reference: AllreduceCollective.allreduce:150 / CollectiveMapper.allreduce:479.
+    """
+    _expect(t, Dist.LOCAL, "allreduce")
+    out = lax_ops.allreduce(t.data, t.combiner, axis_name)
+    return t.with_data(out, Dist.REPLICATED)
+
+
+def reduce(t: Table, root: int = 0, axis_name: str = WORKERS) -> Table:
+    """LOCAL → LOCAL: combined table on ``root``, identity elsewhere
+    (ReduceCollective.reduce:150)."""
+    _expect(t, Dist.LOCAL, "reduce")
+    out = lax_ops.reduce(t.data, root, t.combiner, axis_name)
+    return t.with_data(out, Dist.LOCAL)
+
+
+def broadcast(t: Table, root: int = 0, axis_name: str = WORKERS) -> Table:
+    """LOCAL@root → REPLICATED (BcastCollective.broadcast:338)."""
+    out = lax_ops.broadcast(t.data, root, axis_name)
+    return t.with_data(out, Dist.REPLICATED)
+
+
+def regroup(
+    t: Table,
+    partitioner: Optional[partitioner_lib.Partitioner] = None,
+    axis_name: str = WORKERS,
+) -> Table:
+    """LOCAL → SHARDED: route each partition to its owner, combining contributions.
+
+    Reference: RegroupCollective.regroupCombine:154 (partitioner → P2P dispatch →
+    combine-on-arrival). Lowered to reduce_scatter (SUM/AVG) or all_to_all+combine.
+    """
+    _expect(t, Dist.LOCAL, "regroup")
+    perm = partitioner.permutation() if partitioner is not None else None
+    data = _perm_apply(t.data, perm)
+    out = lax_ops.reduce_scatter(data, t.combiner, axis_name)
+    return t.with_data(out, Dist.SHARDED)
+
+
+def allgather(
+    t: Table,
+    partitioner: Optional[partitioner_lib.Partitioner] = None,
+    axis_name: str = WORKERS,
+) -> Table:
+    """SHARDED → REPLICATED (AllgatherCollective.allgather:147, ring relay).
+
+    ``partitioner`` must match the one used at regroup time so partition-ID order is
+    restored after the gather.
+    """
+    _expect(t, Dist.SHARDED, "allgather")
+    full = lax_ops.allgather(t.data, axis_name)
+    inv = partitioner.inverse_permutation() if partitioner is not None else None
+    full = _perm_apply(full, inv)
+    return t.with_data(full, Dist.REPLICATED)
+
+
+def aggregate(
+    t: Table,
+    partitioner: Optional[partitioner_lib.Partitioner] = None,
+    axis_name: str = WORKERS,
+) -> Table:
+    """LOCAL → REPLICATED via regroup+allgather (RegroupCollective.aggregate:268).
+
+    On TPU this is exactly reduce_scatter + all_gather — the bandwidth-optimal
+    allreduce decomposition — so ``aggregate`` and ``allreduce`` cost the same; Harp
+    exposed both because its TCP implementations differed.
+    """
+    return allgather(regroup(t, partitioner, axis_name), partitioner, axis_name)
+
+
+def rotate(t: Table, steps: int = 1, axis_name: str = WORKERS) -> Table:
+    """SHARDED → SHARDED: ring-shift ownership by ``steps``
+    (LocalGlobalSyncCollective.rotate:710 → ppermute over the ICI ring)."""
+    _expect(t, Dist.SHARDED, "rotate")
+    return t.with_data(lax_ops.rotate(t.data, steps, axis_name))
+
+
+def rotate_with_map(t: Table, mapping: dict, axis_name: str = WORKERS) -> Table:
+    """Rotate with an explicit worker→worker map (rotateGlobal:746)."""
+    _expect(t, Dist.SHARDED, "rotate")
+    return t.with_data(lax_ops.rotate_map(t.data, mapping, axis_name))
+
+
+def push(
+    local: Table,
+    global_table: Table,
+    partitioner: Optional[partitioner_lib.Partitioner] = None,
+    axis_name: str = WORKERS,
+) -> Table:
+    """Parameter-server push: combine LOCAL contributions into the persistent
+    SHARDED global table (LocalGlobalSyncCollective.push:209)."""
+    _expect(local, Dist.LOCAL, "push")
+    _expect(global_table, Dist.SHARDED, "push(global)")
+    delta = regroup(local, partitioner, axis_name)
+    merged = global_table.combiner.fn(global_table.data, delta.data)
+    return global_table.with_data(merged)
+
+
+def pull(
+    global_table: Table,
+    partitioner: Optional[partitioner_lib.Partitioner] = None,
+    axis_name: str = WORKERS,
+) -> Table:
+    """Parameter-server pull: SHARDED global → REPLICATED local copy
+    (LocalGlobalSyncCollective.pull:185; the chain-bcast variant :228-295 is an XLA
+    scheduling detail here)."""
+    return allgather(global_table, partitioner, axis_name)
+
+
+def gather(t: Table, root: int = 0, axis_name: str = WORKERS) -> Table:
+    """SHARDED → root holds the full table (Communication.gather:196)."""
+    _expect(t, Dist.SHARDED, "gather")
+    out = lax_ops.gather(t.data, root, axis_name)
+    return t.with_data(out, Dist.LOCAL)
+
+
+def group_by_key(
+    keys: jax.Array,
+    values: jax.Array,
+    num_keys: int,
+    combiner: combiner_lib.Combiner = combiner_lib.SUM,
+    axis_name: str = WORKERS,
+) -> jax.Array:
+    """GroupByKeyCollective:42 — shuffle KV pairs by key, combining equal keys.
+
+    TPU-native: all_gather the (key, value) records, then a masked segment reduction
+    into the dense key space. Returns the combined value per key, REPLICATED.
+    ``num_keys`` must be static (the key-space size).
+    """
+    all_keys = lax_ops.allgather(keys, axis_name)
+    all_vals = lax_ops.allgather(values, axis_name)
+    if combiner.op in (combiner_lib.Op.SUM, combiner_lib.Op.AVG):
+        out = jax.ops.segment_sum(all_vals, all_keys, num_segments=num_keys)
+        if combiner.op is combiner_lib.Op.AVG:
+            counts = jax.ops.segment_sum(jnp.ones_like(all_keys), all_keys,
+                                         num_segments=num_keys)
+            out = out / jnp.maximum(counts, 1).astype(out.dtype).reshape(
+                (-1,) + (1,) * (out.ndim - 1))
+        return out
+    if combiner.op is combiner_lib.Op.MAX:
+        return jax.ops.segment_max(all_vals, all_keys, num_segments=num_keys)
+    if combiner.op is combiner_lib.Op.MIN:
+        return jax.ops.segment_min(all_vals, all_keys, num_segments=num_keys)
+    raise ValueError(f"group_by_key unsupported for {combiner.op}")
+
+
+def _expect(t: Table, dist: Dist, op: str) -> None:
+    if t.dist is not dist:
+        raise ValueError(
+            f"{op} expects a {dist.value} table, got {t.dist.value} "
+            f"(table {t.name!r}); see harp_tpu.table state model"
+        )

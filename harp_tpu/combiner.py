@@ -1,0 +1,121 @@
+"""Combiner operations — element-wise merge semantics for table partitions.
+
+Reference parity: Harp's ``combiner/`` package (ByteArrCombiner … DoubleArrCombiner,
+operations enumerated in combiner/Operation.java:9: SUM, MULTIPLY, MINUS, MAX, MIN, AVG)
+and the ``PartitionCombiner`` contract (partition/PartitionCombiner.java:25).
+
+TPU-native design: instead of per-dtype combiner classes that merge Java arrays in
+place, a combiner here is a *reduction algebra*: an identity element, a binary
+element-wise op, and the matching XLA cross-replica collective (``psum`` / ``pmax`` /
+``pmin``). Every Harp collective that "combines partitions by ID" lowers to the
+combiner's collective over the mesh axis, which XLA maps onto ICI reductions.
+
+MINUS and AVG are not associative reductions; Harp applies them pairwise in arrival
+order (non-deterministic!). Here they are defined deterministically: MINUS(a, b…) =
+a - sum(b…) (root minus the sum of the rest) and AVG = SUM / contributor count, which
+matches the fixed-order result and is reproducible.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+class Op(enum.Enum):
+    """Combine operations (reference: combiner/Operation.java:9)."""
+
+    SUM = "sum"
+    MULTIPLY = "multiply"
+    MINUS = "minus"
+    MAX = "max"
+    MIN = "min"
+    AVG = "avg"
+
+
+@dataclasses.dataclass(frozen=True)
+class Combiner:
+    """A reduction algebra used by Table collectives.
+
+    Attributes:
+      op: the logical operation.
+      fn: associative binary element-wise op used for pairwise combines.
+      identity: identity element for ``fn`` (used to pad ragged partitions so padding
+        never perturbs a reduction).
+    """
+
+    op: Op
+    fn: Callable[[jax.Array, jax.Array], jax.Array]
+    identity: float
+
+    def tree_combine(self, x: jax.Array, axis: int = 0) -> jax.Array:
+        """Reduce along ``axis`` with this combiner's semantics (local, on-device)."""
+        if self.op is Op.SUM:
+            return jnp.sum(x, axis=axis)
+        if self.op is Op.MULTIPLY:
+            return jnp.prod(x, axis=axis)
+        if self.op is Op.MAX:
+            return jnp.max(x, axis=axis)
+        if self.op is Op.MIN:
+            return jnp.min(x, axis=axis)
+        if self.op is Op.AVG:
+            return jnp.mean(x, axis=axis)
+        if self.op is Op.MINUS:
+            # Deterministic pairwise-left semantics: first minus the sum of the rest.
+            first = jax.lax.index_in_dim(x, 0, axis=axis, keepdims=False)
+            rest = jnp.sum(x, axis=axis) - first
+            return first - rest
+        raise ValueError(f"unknown op {self.op}")
+
+    def psum_like(self, x: jax.Array, axis_name: str) -> jax.Array:
+        """Cross-worker reduction over a mesh axis (inside shard_map/pmap)."""
+        if self.op is Op.SUM:
+            return jax.lax.psum(x, axis_name)
+        if self.op is Op.MAX:
+            return jax.lax.pmax(x, axis_name)
+        if self.op is Op.MIN:
+            return jax.lax.pmin(x, axis_name)
+        if self.op is Op.AVG:
+            return jax.lax.pmean(x, axis_name)
+        if self.op is Op.MULTIPLY:
+            # XLA has no pprod; do it in log-space-free form via all_gather+prod,
+            # which XLA fuses into a single collective on ICI.
+            g = jax.lax.all_gather(x, axis_name)
+            return jnp.prod(g, axis=0)
+        if self.op is Op.MINUS:
+            idx = jax.lax.axis_index(axis_name)
+            first = jnp.where(idx == 0, x, jnp.zeros_like(x))
+            first = jax.lax.psum(first, axis_name)
+            rest = jax.lax.psum(x, axis_name) - first
+            return first - rest
+        raise ValueError(f"unknown op {self.op}")
+
+
+_COMBINERS = {
+    Op.SUM: Combiner(Op.SUM, jnp.add, 0.0),
+    Op.MULTIPLY: Combiner(Op.MULTIPLY, jnp.multiply, 1.0),
+    Op.MINUS: Combiner(Op.MINUS, jnp.subtract, 0.0),
+    Op.MAX: Combiner(Op.MAX, jnp.maximum, -jnp.inf),
+    Op.MIN: Combiner(Op.MIN, jnp.minimum, jnp.inf),
+    Op.AVG: Combiner(Op.AVG, jnp.add, 0.0),
+}
+
+
+def get(op: Op | str) -> Combiner:
+    """Look up the combiner for an operation (accepts Op or its string name)."""
+    if isinstance(op, str):
+        op = Op(op.lower())
+    return _COMBINERS[op]
+
+
+# Convenience singletons mirroring Harp's example combiners (example/DoubleArrPlus etc.)
+SUM = _COMBINERS[Op.SUM]
+MULTIPLY = _COMBINERS[Op.MULTIPLY]
+MINUS = _COMBINERS[Op.MINUS]
+MAX = _COMBINERS[Op.MAX]
+MIN = _COMBINERS[Op.MIN]
+AVG = _COMBINERS[Op.AVG]

@@ -1,0 +1,89 @@
+"""Partitioners — map partition IDs to owning workers.
+
+Reference parity: ``partition/Partitioner`` (partition/Partitioner.java:24, default
+``partitionID % numWorkers``) and the per-algorithm custom partitioners.
+
+TPU-native design: XLA collectives want *block* layouts — worker ``w`` owns the
+contiguous slice ``[w*B, (w+1)*B)`` of the partition axis, because that is what
+``psum_scatter``/``all_gather`` produce natively. So the canonical owner map here is
+BLOCK, and MODULO (Harp's default) is expressed as BLOCK composed with a static
+permutation of the partition axis. Arbitrary owner maps are supported the same way:
+any assignment with equal per-worker counts is a permutation away from BLOCK; unequal
+assignments are padded to the max count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Partitioner:
+    """Maps each of ``num_partitions`` IDs to one of ``num_workers`` owners.
+
+    ``permutation()`` returns the static index vector ``perm`` such that reordering
+    the partition axis by ``perm`` puts every worker's partitions into one contiguous
+    block (worker 0's block first). ``num_partitions`` must be a multiple of
+    ``num_workers`` after padding (Table handles padding).
+    """
+
+    num_partitions: int
+    num_workers: int
+
+    def owner(self, pid: np.ndarray | int):
+        raise NotImplementedError
+
+    def permutation(self) -> np.ndarray:
+        pids = np.arange(self.num_partitions)
+        owners = np.asarray(self.owner(pids))
+        counts = np.bincount(owners, minlength=self.num_workers)
+        if counts.max() != counts.min():
+            raise ValueError(
+                "unequal partitions per worker "
+                f"({counts.tolist()}); pad the table first"
+            )
+        # Stable sort by owner: block order, preserving ID order within a worker.
+        return np.argsort(owners, kind="stable")
+
+    def inverse_permutation(self) -> np.ndarray:
+        perm = self.permutation()
+        inv = np.empty_like(perm)
+        inv[perm] = np.arange(len(perm))
+        return inv
+
+    @property
+    def is_block(self) -> bool:
+        return bool(np.all(self.permutation() == np.arange(self.num_partitions)))
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockPartitioner(Partitioner):
+    """Worker w owns contiguous block w — the XLA-native layout."""
+
+    def owner(self, pid):
+        block = self.num_partitions // self.num_workers
+        return np.asarray(pid) // block
+
+
+@dataclasses.dataclass(frozen=True)
+class ModuloPartitioner(Partitioner):
+    """Harp's default: owner = pid % num_workers (partition/Partitioner.java:24)."""
+
+    def owner(self, pid):
+        return np.asarray(pid) % self.num_workers
+
+
+@dataclasses.dataclass(frozen=True)
+class CustomPartitioner(Partitioner):
+    """Explicit owner table (tuple so the dataclass stays hashable/static)."""
+
+    owners: tuple = ()
+
+    def owner(self, pid):
+        return np.asarray(self.owners)[np.asarray(pid)]
+
+
+def default_partitioner(num_partitions: int, num_workers: int) -> Partitioner:
+    return BlockPartitioner(num_partitions, num_workers)
