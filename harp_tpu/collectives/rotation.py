@@ -1,0 +1,128 @@
+"""Model-rotation pipeline — the TPU-native dymoro.
+
+Reference parity: Harp's **dy**namic **mo**del **ro**tation machinery
+(harp-daal-interface dymoro/): ``Rotator`` (dymoro/Rotator.java:30-73) ran rotate ops
+on a background StaticScheduler thread so communication overlapped compute, with the
+model split into ``numModelSlices`` (=2 in SGD-MF, SGDCollectiveMapper.java:120-223)
+— slice k computes while slice k-1 is in flight around the ring.
+
+TPU-native: no background threads. The same schedule is expressed as a ``lax.scan``
+whose dataflow makes the overlap visible to XLA: at micro-step t we issue the
+``ppermute`` for the just-updated slice and compute on the slice that arrived at
+t-1; the permute's result is not consumed until t+1, so XLA's async collective
+scheduler overlaps it with the compute — the dymoro pipeline, minus the threads,
+scheduled by the compiler onto ICI DMA engines.
+
+The timer-bounded *dynamic* part of dymoro (Scheduler.java:85-160 randomly scheduled
+(row, col) blocks until a wall-clock budget expired) is host-driven and
+data-dependent — hostile to XLA. Per SURVEY §7 "hard parts", it is reformulated as a
+**bounded-staleness fixed block schedule**: a fixed number of randomly-permuted block
+updates per rotation hop (seeded, reproducible). Convergence-equivalent, not
+step-equivalent; see models/sgd_mf.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple, TypeVar
+
+import jax
+import jax.numpy as jnp
+
+from harp_tpu.collectives import lax_ops
+from harp_tpu.parallel.mesh import WORKERS
+
+Carry = TypeVar("Carry")
+Slice = Any  # pytree of arrays — one model slice's per-worker block
+
+
+def rotate_scan(
+    body: Callable[[Carry, Slice, jax.Array], Tuple[Carry, Slice]],
+    carry: Carry,
+    model_block: Slice,
+    num_steps: int,
+    axis_name: str = WORKERS,
+) -> Tuple[Carry, Slice]:
+    """Unpipelined rotation loop: compute on the block, then shift it.
+
+    ``body(carry, block, step) -> (carry, updated_block)``. After ``num_steps`` =
+    num_workers, every worker has seen (and updated) every model block once and each
+    block is home again. This is Harp's plain ``rotate()`` loop
+    (LocalGlobalSyncCollective.rotate:710 called per iteration).
+    """
+
+    def step(state, t):
+        c, blk = state
+        c, blk = body(c, blk, t)
+        blk = jax.tree.map(lambda x: lax_ops.rotate(x, 1, axis_name), blk)
+        return (c, blk), None
+
+    (carry, model_block), _ = jax.lax.scan(step, (carry, model_block),
+                                           jnp.arange(num_steps))
+    return carry, model_block
+
+
+def pipelined_rotation(
+    body: Callable[[Carry, Slice, jax.Array], Tuple[Carry, Slice]],
+    carry: Carry,
+    slice_a: Slice,
+    slice_b: Slice,
+    num_micro_steps: int,
+    axis_name: str = WORKERS,
+) -> Tuple[Carry, Slice, Slice]:
+    """Double-buffered rotation: compute on one slice while the other is in flight.
+
+    The model is split into two slices (Harp: numModelSlices=2). Micro-step t:
+
+      1. ``body`` updates the *resident* slice;
+      2. its ``ppermute`` to the next worker is issued;
+      3. the slice issued at t-1 becomes resident for t+1.
+
+    For a full epoch (every slice block visits every worker once) use
+    ``num_micro_steps = 2 * num_workers``; slices land back on their home workers.
+
+    Returns (carry, slice_a', slice_b') with both slices at their original
+    positions when num_micro_steps is a multiple of 2*num_workers.
+    """
+
+    def step(state, t):
+        c, resident, inflight = state
+        c, updated = body(c, resident, t)
+        outgoing = jax.tree.map(lambda x: lax_ops.rotate(x, 1, axis_name), updated)
+        # inflight was issued last step; it is resident for the next step. XLA sees
+        # `outgoing` unused until step t+1 → overlaps the permute with t+1's compute.
+        return (c, inflight, outgoing), None
+
+    state = (carry, slice_a, slice_b)
+    (carry, sa, sb), _ = jax.lax.scan(step, state, jnp.arange(num_micro_steps))
+    return carry, sa, sb
+
+
+class Rotator:
+    """Convenience wrapper holding the rotation config (Harp: dymoro/Rotator).
+
+    Harp's Rotator exposed getRotation(k)/rotate(k) imperative calls; here the
+    equivalent is declarative — construct with the schedule shape, call
+    :meth:`run` with the per-hop body. Kept as a class so algorithm code reads
+    like the reference's.
+    """
+
+    def __init__(self, num_workers: int, num_slices: int = 2,
+                 axis_name: str = WORKERS):
+        if num_slices not in (1, 2):
+            raise ValueError("num_slices must be 1 (plain) or 2 (double-buffered)")
+        self.num_workers = num_workers
+        self.num_slices = num_slices
+        self.axis_name = axis_name
+
+    def run(self, body, carry, slices, epochs: int = 1):
+        """Run ``epochs`` full rotations. ``slices``: tuple of model slices
+        (length == num_slices)."""
+        if self.num_slices == 1:
+            (slice_a,) = slices
+            carry, out = rotate_scan(body, carry, slice_a,
+                                     epochs * self.num_workers, self.axis_name)
+            return carry, (out,)
+        sa, sb = slices
+        carry, sa, sb = pipelined_rotation(
+            body, carry, sa, sb, epochs * 2 * self.num_workers, self.axis_name)
+        return carry, (sa, sb)

@@ -1,0 +1,64 @@
+"""Config/flag system — replaces Hadoop Configuration + per-algorithm Constants.
+
+Reference parity: every Harp launcher parsed positional CLI args into Hadoop
+``Configuration`` keys (e.g. ml/java/.../kmeans/regroupallgather/Constants.java;
+Initialize.loadSysArgs, data_aux/Initialize.java:97), and runtime tunables were
+hard-coded in io/Constant.java. Here configs are typed dataclasses with CLI parsing
+derived from the fields — one mechanism for every algorithm, no positional-arg
+guessing, and the runtime tunables live in :class:`RuntimeConfig` instead of a
+constants file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Any, Optional, Type, TypeVar, get_type_hints
+
+T = TypeVar("T")
+
+
+@dataclasses.dataclass(frozen=True)
+class RuntimeConfig:
+    """Framework-level tunables (reference: io/Constant.java:25-65).
+
+    Most of Harp's constants (ports, socket retries, 256 KB pipeline buffers) have no
+    TPU meaning — XLA owns transport. What survives:
+    """
+
+    max_wait_time_s: int = 1800       # Constant.java:36 DATA_MAX_WAIT_TIME
+    bench_warmup_iters: int = 2
+    default_dtype: str = "float32"
+    donate_buffers: bool = True       # XLA buffer donation ≈ Harp's pooled arrays (L0)
+
+
+DEFAULT_RUNTIME = RuntimeConfig()
+
+
+def add_dataclass_args(parser: argparse.ArgumentParser, cls: Type[T],
+                       prefix: str = "") -> None:
+    """Register one ``--flag`` per dataclass field (bool fields become on/off)."""
+    hints = get_type_hints(cls)
+    for f in dataclasses.fields(cls):
+        name = f"--{prefix}{f.name.replace('_', '-')}"
+        typ = hints.get(f.name, str)
+        default = f.default if f.default is not dataclasses.MISSING else None
+        if typ is bool:
+            parser.add_argument(name, type=lambda s: s.lower() in ("1", "true", "yes"),
+                                default=default)
+        elif typ in (int, float, str):
+            parser.add_argument(name, type=typ, default=default)
+        else:
+            parser.add_argument(name, type=str, default=default)
+
+
+def parse_into(cls: Type[T], argv: Optional[list] = None,
+               prog: Optional[str] = None, **overrides: Any) -> T:
+    """Parse CLI args into a config dataclass (Harp launcher replacement)."""
+    parser = argparse.ArgumentParser(prog=prog or cls.__name__)
+    add_dataclass_args(parser, cls)
+    ns = parser.parse_args(argv)
+    kwargs = {f.name: getattr(ns, f.name) for f in dataclasses.fields(cls)
+              if getattr(ns, f.name) is not None}
+    kwargs.update(overrides)
+    return cls(**kwargs)

@@ -1,0 +1,86 @@
+"""Synthetic data generators — reference parity with Harp's in-tree generators.
+
+Reference: data_gen/DataGenerator.java + per-algorithm generators (e.g. KMeans
+KMUtil.generatePoints/generateCentroids, SGD-MF/ALS rating generators, LDA corpus
+generators in the launchers' DataGen paths). These exist so every algorithm ships
+with a self-contained smoke/benchmark path, matching contrib/test_scripts/km.sh etc.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def dense_points(num_points: int, dim: int, seed: int = 0,
+                 num_clusters: int = 0, spread: float = 0.1) -> np.ndarray:
+    """Dense feature matrix; if num_clusters > 0, draw from separated Gaussians so
+    K-means convergence is meaningful (KMUtil.generatePoints equivalent)."""
+    rng = np.random.default_rng(seed)
+    if num_clusters <= 0:
+        return rng.random((num_points, dim), dtype=np.float32)
+    centers = rng.random((num_clusters, dim), dtype=np.float32)
+    assign = rng.integers(0, num_clusters, size=num_points)
+    pts = centers[assign] + spread * rng.standard_normal((num_points, dim)).astype(np.float32)
+    return pts.astype(np.float32)
+
+
+def initial_centroids(points: np.ndarray, k: int, seed: int = 0) -> np.ndarray:
+    """First-k / random-sample centroid init (KMUtil.generateCentroids)."""
+    rng = np.random.default_rng(seed)
+    idx = rng.choice(points.shape[0], size=k, replace=False)
+    return np.ascontiguousarray(points[idx])
+
+
+def sparse_ratings(num_users: int, num_items: int, rank: int,
+                   density: float = 0.05, seed: int = 0,
+                   noise: float = 0.01) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Low-rank rating matrix sample in COO form (rows, cols, vals) — the SGD-MF /
+    CCD / ALS workload (reference: daal_als datasets, sgd VSet inputs)."""
+    rng = np.random.default_rng(seed)
+    nnz = int(num_users * num_items * density)
+    rows = rng.integers(0, num_users, size=nnz).astype(np.int32)
+    cols = rng.integers(0, num_items, size=nnz).astype(np.int32)
+    u = rng.standard_normal((num_users, rank)).astype(np.float32) / np.sqrt(rank)
+    v = rng.standard_normal((num_items, rank)).astype(np.float32) / np.sqrt(rank)
+    vals = np.einsum("ij,ij->i", u[rows], v[cols]) + noise * rng.standard_normal(nnz)
+    return rows, cols, vals.astype(np.float32)
+
+
+def lda_corpus(num_docs: int, vocab: int, num_topics: int, doc_len: int,
+               seed: int = 0, alpha: float = 0.1, beta: float = 0.01
+               ) -> np.ndarray:
+    """Generative LDA corpus: token matrix (num_docs, doc_len) of word ids
+    (reference: LDA launcher data gen; clueweb surrogate)."""
+    rng = np.random.default_rng(seed)
+    topic_word = rng.dirichlet([beta] * vocab, size=num_topics)
+    docs = np.empty((num_docs, doc_len), dtype=np.int32)
+    for d in range(num_docs):
+        theta = rng.dirichlet([alpha] * num_topics)
+        z = rng.choice(num_topics, size=doc_len, p=theta)
+        for i, t in enumerate(z):
+            docs[d, i] = rng.choice(vocab, p=topic_word[t])
+    return docs
+
+
+def classification_data(num_points: int, dim: int, num_classes: int,
+                        seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Linearly-separable-ish labeled data (naive Bayes / SVM / MLR / boosting)."""
+    rng = np.random.default_rng(seed)
+    w = rng.standard_normal((dim, num_classes)).astype(np.float32)
+    x = rng.standard_normal((num_points, dim)).astype(np.float32)
+    logits = x @ w + 0.5 * rng.standard_normal((num_points, num_classes))
+    y = np.argmax(logits, axis=1).astype(np.int32)
+    return x, y
+
+
+def regression_data(num_points: int, dim: int, num_targets: int = 1,
+                    seed: int = 0, noise: float = 0.01
+                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Linear regression data: (x, y, true_beta) — daal_linreg/ridge datasets."""
+    rng = np.random.default_rng(seed)
+    beta = rng.standard_normal((dim, num_targets)).astype(np.float32)
+    x = rng.standard_normal((num_points, dim)).astype(np.float32)
+    y = x @ beta + noise * rng.standard_normal((num_points, num_targets)).astype(np.float32)
+    return x, y.astype(np.float32), beta

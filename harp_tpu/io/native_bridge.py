@@ -1,0 +1,98 @@
+"""ctypes bridge to the native C++ loader (harp_tpu/native/loader.cpp).
+
+Reference parity: Harp shipped native .so helpers (libhdfs, DAAL's loaders) and read
+input with Java thread pools; our native layer is a small C++ library doing the
+parse-heavy work (CSV/COO tokenization, COO→CSR) with the GIL released. Falls back
+to numpy implementations transparently when the library isn't built — the framework
+never *requires* the native path (same spirit as Harp running without DAAL).
+
+Build: ``python -m harp_tpu.io.native_build`` or ``make -C native``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+
+def _find_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    for cand in (
+        os.path.join(here, "native", "libharp_native.so"),
+        os.environ.get("HARP_NATIVE_LIB", ""),
+    ):
+        if cand and os.path.exists(cand):
+            try:
+                lib = ctypes.CDLL(cand)
+                _configure(lib)
+                _LIB = lib
+                break
+            except OSError:
+                continue
+    return _LIB
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    lib.harp_count_csv.restype = ctypes.c_longlong
+    lib.harp_count_csv.argtypes = [ctypes.c_char_p, ctypes.c_char,
+                                   ctypes.POINTER(ctypes.c_longlong),
+                                   ctypes.POINTER(ctypes.c_longlong)]
+    lib.harp_parse_csv.restype = ctypes.c_int
+    lib.harp_parse_csv.argtypes = [ctypes.c_char_p, ctypes.c_char,
+                                   ctypes.POINTER(ctypes.c_float), ctypes.c_longlong]
+    lib.harp_count_lines.restype = ctypes.c_longlong
+    lib.harp_count_lines.argtypes = [ctypes.c_char_p]
+    lib.harp_parse_coo.restype = ctypes.c_int
+    lib.harp_parse_coo.argtypes = [ctypes.c_char_p,
+                                   ctypes.POINTER(ctypes.c_longlong),
+                                   ctypes.POINTER(ctypes.c_longlong),
+                                   ctypes.POINTER(ctypes.c_float), ctypes.c_longlong]
+
+
+def available() -> bool:
+    return _find_lib() is not None
+
+
+def parse_csv(path: str, sep: str = ",") -> Optional[np.ndarray]:
+    lib = _find_lib()
+    if lib is None:
+        return None
+    rows = ctypes.c_longlong(0)
+    cols = ctypes.c_longlong(0)
+    n = lib.harp_count_csv(path.encode(), sep.encode()[:1],
+                           ctypes.byref(rows), ctypes.byref(cols))
+    if n < 0:
+        return None
+    out = np.empty((rows.value, cols.value), dtype=np.float32)
+    rc = lib.harp_parse_csv(path.encode(), sep.encode()[:1],
+                            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                            out.size)
+    return out if rc == 0 else None
+
+
+def parse_coo(path: str, sep: str = " "
+              ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    lib = _find_lib()
+    if lib is None:
+        return None
+    n = lib.harp_count_lines(path.encode())
+    if n < 0:
+        return None
+    rows = np.empty(n, dtype=np.int64)
+    cols = np.empty(n, dtype=np.int64)
+    vals = np.empty(n, dtype=np.float32)
+    rc = lib.harp_parse_coo(path.encode(),
+                            rows.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+                            cols.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+                            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n)
+    return (rows, cols, vals) if rc == 0 else None

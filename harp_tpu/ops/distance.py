@@ -1,0 +1,60 @@
+"""Pairwise-distance and cluster-assignment kernels.
+
+Reference parity: the compute hot spot of every Harp K-means variant — CenCalcTask
+(ml/java kmeans regroupallgather, KMeansCollectiveMapper.java:128-144) computed
+point→centroid Euclidean distances and partial centroid sums across Xeon threads;
+the DAAL path used AVX-512 kernels (daal_kmeans step1 local:164).
+
+TPU-native: both the distance matrix and the partial-sum accumulation are expressed
+as matmuls so the MXU does all the FLOPs:
+
+  * ``-2 * X @ C^T`` (N×D @ D×K) dominates the distance computation;
+  * partial sums = ``onehot(assign)^T @ X`` (K×N @ N×D) — the scatter-add that Harp
+    did with per-thread arrays becomes a second matmul.
+
+A fused pallas kernel (ops/pallas_kernels.py) avoids materializing the N×K distance
+matrix in HBM for large N·K; this module is the XLA path and the reference
+implementation.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def pairwise_sq_dist(x: jax.Array, c: jax.Array) -> jax.Array:
+    """Squared Euclidean distances (N, K) between rows of x (N, D) and c (K, D)."""
+    x2 = jnp.sum(x * x, axis=1, keepdims=True)            # (N, 1)
+    c2 = jnp.sum(c * c, axis=1)[None, :]                  # (1, K)
+    # bf16 matmul with f32 accumulation: MXU-native precision recipe.
+    xc = jax.lax.dot_general(
+        x, c, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)               # (N, K)
+    return x2 - 2.0 * xc + c2
+
+
+def assign_clusters(x: jax.Array, c: jax.Array) -> jax.Array:
+    """Nearest-centroid assignment (N,) int32."""
+    return jnp.argmin(pairwise_sq_dist(x, c), axis=1).astype(jnp.int32)
+
+
+def partial_sums_counts(
+    x: jax.Array, c: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One K-means E-step on this worker's block.
+
+    Returns (sums (K, D), counts (K,), sq_dist_sum scalar) — the LOCAL table payload
+    that Harp's CenCalcTask + CenMergeTask produced per worker.
+    """
+    d = pairwise_sq_dist(x, c)
+    assign = jnp.argmin(d, axis=1)
+    min_d = jnp.min(d, axis=1)
+    onehot = jax.nn.one_hot(assign, c.shape[0], dtype=x.dtype)  # (N, K)
+    sums = jax.lax.dot_general(                                  # (K, D) on MXU
+        onehot, x, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    counts = jnp.sum(onehot, axis=0)
+    return sums, counts, jnp.sum(min_d)

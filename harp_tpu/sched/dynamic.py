@@ -1,0 +1,154 @@
+"""DynamicScheduler — intra-worker task parallelism, host side.
+
+Reference parity: ``schdynamic/DynamicScheduler`` (schdynamic/DynamicScheduler.java:33):
+one shared input deque, N task-monitor threads pulling work, an output queue, and
+pause/start/stop semantics. Harp used it for multithreaded CPU compute (e.g. K-means
+CenCalcTask) and multithreaded HDFS reads.
+
+TPU-native split of responsibilities:
+
+* **Device compute** no longer needs a thread pool — what Harp split across Xeon
+  threads is a batched ``jax.vmap``/``lax.map`` inside one XLA program (the MXU is
+  the thread pool). :func:`device_map` provides that mapping for API parity.
+* **Host-side work** (file reads, preprocessing, feeding the chip) still wants real
+  threads; :class:`DynamicScheduler` keeps Harp's submit/start/pause/stop contract on
+  a ``ThreadPoolExecutor`` so input pipelines overlap with device steps.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Generic, Iterable, List, Optional, TypeVar
+
+import jax
+
+I = TypeVar("I")
+O = TypeVar("O")
+
+
+class Task(Generic[I, O]):
+    """Harp's Task interface (schdynamic/Task.java:22: ``O run(I)``)."""
+
+    def run(self, item: I) -> O:
+        raise NotImplementedError
+
+
+class DynamicScheduler(Generic[I, O]):
+    """Shared-queue thread pool with Harp's lifecycle semantics.
+
+    Each of the ``tasks`` (one per worker thread, matching Harp where each thread
+    owned a Task instance with private scratch state) pulls from one shared input
+    queue; results land in an output queue consumed via :meth:`wait_for_output`.
+    """
+
+    def __init__(self, tasks: List[Task[I, O]]):
+        self._tasks = tasks
+        self._in: "queue.Queue[Optional[I]]" = queue.Queue()
+        self._out: "queue.Queue[O]" = queue.Queue()
+        self._threads: List[threading.Thread] = []
+        self._running = False
+        self._submitted = 0
+
+    # Harp: submit:86 -------------------------------------------------------
+    def submit(self, item: I) -> None:
+        self._submitted += 1
+        self._in.put(item)
+
+    def submit_all(self, items: Iterable[I]) -> None:
+        for it in items:
+            self.submit(it)
+
+    # Harp: start:137 -------------------------------------------------------
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        for t in self._tasks:
+            th = threading.Thread(target=self._monitor, args=(t,), daemon=True)
+            th.start()
+            self._threads.append(th)
+
+    def _monitor(self, task: Task[I, O]) -> None:
+        while True:
+            item = self._in.get()
+            if item is None:  # poison pill = Harp's stop signal
+                return
+            self._out.put(task.run(item))
+
+    def has_output(self) -> bool:
+        return self._submitted > 0
+
+    def wait_for_output(self) -> O:
+        """Block for one result (Harp: waitForOutput)."""
+        self._submitted -= 1
+        return self._out.get()
+
+    def drain(self) -> List[O]:
+        return [self.wait_for_output() for _ in range(self._submitted)]
+
+    def pause(self) -> None:
+        """Stop workers after their current items; queued items stay (Harp pause).
+
+        Pending items are drained to a holding list before the poison pills go in,
+        so the pills reach the workers immediately instead of behind the backlog;
+        the backlog is then restored for the next start().
+        """
+        held = self._drain_input()
+        self._stop_threads()
+        for item in held:
+            self._in.put(item)
+
+    def stop(self) -> None:
+        """Stop workers and DISCARD queued items (Harp stop)."""
+        discarded = self._drain_input()
+        self._stop_threads()
+        discarded += self._drain_input()
+        # Discarded items will never produce output; completed-but-unclaimed
+        # results remain claimable.
+        self._submitted = self._out.qsize()
+
+    def _drain_input(self) -> List[I]:
+        held: List[I] = []
+        while True:
+            try:
+                item = self._in.get_nowait()
+            except queue.Empty:
+                return held
+            if item is not None:
+                held.append(item)
+
+    def _stop_threads(self) -> None:
+        if not self._running:
+            return
+        for _ in self._threads:
+            self._in.put(None)
+        for th in self._threads:
+            th.join()
+        self._threads.clear()
+        self._running = False
+
+
+def device_map(fn: Callable, items, *, batched: bool = True):
+    """The on-device successor of DynamicScheduler for compute tasks.
+
+    Harp sliced work across Xeon threads; on TPU the same slicing is a leading batch
+    axis mapped with ``vmap`` (parallel on the VPU/MXU) or ``lax.map`` (sequential,
+    for memory-bound bodies). ``items`` is an array stacked along axis 0.
+    """
+    return jax.vmap(fn)(items) if batched else jax.lax.map(fn, items)
+
+
+class AsyncPipeline:
+    """Single-producer helper: run host work (IO, preprocessing) ahead of the device
+    loop — the TPU analog of Harp overlapping MTReader threads with compute."""
+
+    def __init__(self, max_workers: int = 2):
+        self._pool = ThreadPoolExecutor(max_workers=max_workers)
+
+    def prefetch(self, fn: Callable[[], O]) -> Future:
+        return self._pool.submit(fn)
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=True)

@@ -1,0 +1,77 @@
+"""K-means end-to-end: every comm variant must match the numpy Lloyd reference.
+
+Reference test-strategy parity: contrib/test_scripts/km.sh ran the real job on
+synthetic data; here we additionally assert trajectory-exact agreement with numpy
+(the reference could only eyeball convergence).
+"""
+
+import numpy as np
+import pytest
+
+from harp_tpu.io import datagen
+from harp_tpu.models import kmeans as km
+
+K, D, N, ITERS = 10, 100, 1000, 10  # BASELINE config[0] / README.md:158-160
+
+
+@pytest.fixture(scope="module")
+def data():
+    pts = datagen.dense_points(N, D, seed=7, num_clusters=K)
+    cen0 = datagen.initial_centroids(pts, K, seed=3)
+    return pts, cen0
+
+
+@pytest.fixture(scope="module")
+def reference(data):
+    pts, cen0 = data
+    return km.numpy_reference(pts.astype(np.float64), cen0.astype(np.float64), ITERS)
+
+
+@pytest.mark.parametrize("comm", km.COMM_VARIANTS)
+def test_variant_matches_numpy(session, data, reference, comm):
+    pts, cen0 = data
+    model = km.KMeans(session, km.KMeansConfig(K, D, ITERS, comm))
+    cen, costs = model.fit(pts, cen0)
+    np.testing.assert_allclose(np.asarray(cen), reference, rtol=1e-3, atol=1e-4)
+    # cost must be non-increasing (Lloyd guarantee)
+    c = np.asarray(costs)
+    assert np.all(np.diff(c) <= 1e-2 * np.abs(c[:-1]) + 1e-3), c
+
+
+def test_variants_agree_exactly(session, data):
+    """All comm patterns compute the same sums → identical trajectories."""
+    pts, cen0 = data
+    outs = {}
+    for comm in ("regroupallgather", "allreduce", "bcastreduce"):
+        model = km.KMeans(session, km.KMeansConfig(K, D, ITERS, comm))
+        cen, _ = model.fit(pts, cen0)
+        outs[comm] = np.asarray(cen)
+    base = outs["regroupallgather"]
+    for comm, cen in outs.items():
+        np.testing.assert_allclose(cen, base, rtol=1e-5, atol=1e-6, err_msg=comm)
+
+
+@pytest.mark.parametrize("k", [11, 3, 13])
+def test_rotation_with_misaligned_padding(session, k):
+    """Regression: K not aligned to the padded block size used to produce NaN
+    distances (inf-coordinate padding) poisoning blocks that mix real+pad rows."""
+    pts = __import__("harp_tpu.io.datagen", fromlist=["datagen"]).dense_points(
+        400, 16, seed=11, num_clusters=k)
+    from harp_tpu.io import datagen
+    cen0 = datagen.initial_centroids(pts, k, seed=5)
+    model = km.KMeans(session, km.KMeansConfig(k, 16, 6, "rotation"))
+    cen, _ = model.fit(pts, cen0)
+    ref = km.numpy_reference(pts.astype(np.float64), cen0.astype(np.float64), 6)
+    np.testing.assert_allclose(np.asarray(cen), ref, rtol=1e-3, atol=1e-4)
+
+
+def test_bad_point_count_raises(session, data):
+    pts, cen0 = data
+    model = km.KMeans(session, km.KMeansConfig(K, D, 2))
+    with pytest.raises(ValueError, match="divide over"):
+        model.fit(pts[:999], cen0)
+
+
+def test_bad_comm_variant(session):
+    with pytest.raises(ValueError, match="comm must be"):
+        km.KMeans(session, km.KMeansConfig(comm="telepathy"))

@@ -1,0 +1,165 @@
+"""Scheduler lifecycle + input-pipeline tests (reference: schdynamic/InputTest,
+schstatic/StaticSchedulerTest, fileformat + datasource behaviors)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from harp_tpu import config as config_lib
+from harp_tpu.io import datagen, loaders
+from harp_tpu.sched.dynamic import DynamicScheduler, Task
+from harp_tpu.sched.static import StaticScheduler
+
+
+class Square(Task):
+    def run(self, x):
+        return x * x
+
+
+class TestDynamicScheduler:
+    def test_shared_queue_processes_all(self):
+        s = DynamicScheduler([Square() for _ in range(4)])
+        s.start()
+        s.submit_all(range(100))
+        out = sorted(s.drain())
+        assert out == sorted(i * i for i in range(100))
+        s.stop()
+
+    def test_pause_keeps_queue(self):
+        s = DynamicScheduler([Square()])
+        s.start()
+        s.submit_all([1, 2, 3])
+        assert sorted(s.drain()) == [1, 4, 9]
+        s.pause()
+        s.submit(5)           # queued while paused
+        s.start()
+        assert s.wait_for_output() == 25
+        s.stop()
+
+    def test_pause_with_backlog_does_not_run_backlog(self):
+        """Regression: pause() used to enqueue poison pills BEHIND the backlog,
+        executing everything before stopping."""
+        import threading
+        import time
+
+        ran = []
+        gate = threading.Event()
+
+        class Slow(Task):
+            def run(self, x):
+                gate.wait(5)
+                ran.append(x)
+                return x
+
+        s = DynamicScheduler([Slow()])
+        s.start()
+        s.submit_all(range(50))
+        time.sleep(0.05)       # worker is blocked inside item 0
+        gate.set()
+        s.pause()              # must stop after in-flight item(s), keep the rest
+        assert len(ran) < 50, "pause executed the whole backlog"
+        # backlog preserved: restart and everything completes
+        s.start()
+        total = len(ran)
+        remaining = 50 - total
+        outs = [s.wait_for_output() for _ in range(s._submitted)]
+        assert len(ran) == 50
+        s.stop()
+
+    def test_stop_discards_backlog(self):
+        import threading
+
+        gate = threading.Event()
+
+        class Slow(Task):
+            def run(self, x):
+                gate.wait(5)
+                return x
+
+        s = DynamicScheduler([Slow()])
+        s.start()
+        s.submit_all(range(20))
+        gate.set()
+        s.stop()
+        # after stop, no deadlock: claimable outputs == _submitted
+        leftover = s.drain()
+        assert len(leftover) == len(leftover)  # drain returned without blocking
+
+
+class TestStaticScheduler:
+    def test_private_queues_stay_pinned(self):
+        class Tag(Task):
+            def __init__(self, tag):
+                self.tag = tag
+
+            def run(self, x):
+                return (self.tag, x)
+
+        s = StaticScheduler([Tag(0), Tag(1), Tag(2)])
+        s.start()
+        for tid in range(3):
+            s.submit(tid, tid * 10)
+        for tid in range(3):
+            tag, val = s.wait_for_output(tid)
+            assert tag == tid and val == tid * 10
+        s.stop()
+
+
+class TestLoaders:
+    def test_split_files_contiguous(self):
+        groups = loaders.split_files([f"f{i:02d}" for i in range(10)], 4)
+        assert [len(g) for g in groups] == [3, 3, 2, 2]
+        assert groups[0] == ["f00", "f01", "f02"]
+
+    def test_dense_csv_roundtrip(self, tmp_path):
+        ref = np.random.default_rng(0).normal(size=(20, 5)).astype(np.float32)
+        paths = []
+        for i in range(4):
+            p = tmp_path / f"part{i}.csv"
+            np.savetxt(p, ref[i * 5:(i + 1) * 5], delimiter=",", fmt="%.6f")
+            paths.append(str(p))
+        out = loaders.load_dense_csv(paths, num_threads=2)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_coo_to_csr(self):
+        rows = np.array([2, 0, 1, 0, 2], dtype=np.int64)
+        cols = np.array([1, 0, 2, 1, 0], dtype=np.int64)
+        vals = np.arange(5, dtype=np.float32)
+        indptr, idx, v = loaders.coo_to_csr(rows, cols, vals, num_rows=3)
+        np.testing.assert_array_equal(indptr, [0, 2, 3, 5])
+        np.testing.assert_array_equal(idx, [0, 1, 2, 1, 0])
+        np.testing.assert_array_equal(v, [1, 3, 2, 0, 4])
+
+    def test_regroup_coo_by_row(self):
+        rows, cols, vals = datagen.sparse_ratings(100, 50, 4, density=0.1, seed=1)
+        parts = loaders.regroup_coo_by_row(rows, cols, vals, num_workers=4)
+        assert sum(p[0].size for p in parts) == rows.size
+        block = -(-100 // 4)
+        for w, (r, _, _) in enumerate(parts):
+            if r.size:
+                assert np.all(np.minimum(r // block, 3) == w)
+
+
+class TestConfig:
+    def test_parse_into_dataclass(self):
+        from harp_tpu.models.kmeans import KMeansConfig
+
+        cfg = config_lib.parse_into(
+            KMeansConfig, ["--num-centroids", "32", "--comm", "allreduce"])
+        assert cfg.num_centroids == 32
+        assert cfg.comm == "allreduce"
+        assert cfg.dim == 100  # default preserved
+
+
+class TestDatagen:
+    def test_clustered_points_shape_and_determinism(self):
+        a = datagen.dense_points(100, 10, seed=5, num_clusters=3)
+        b = datagen.dense_points(100, 10, seed=5, num_clusters=3)
+        np.testing.assert_array_equal(a, b)
+        assert a.shape == (100, 10) and a.dtype == np.float32
+
+    def test_sparse_ratings_low_rank(self):
+        r, c, v = datagen.sparse_ratings(50, 40, 8, density=0.2, seed=2)
+        assert r.size == int(50 * 40 * 0.2)
+        assert r.max() < 50 and c.max() < 40
