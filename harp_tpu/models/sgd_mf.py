@@ -1,0 +1,195 @@
+"""SGD matrix factorization — the model-rotation flagship (Model B).
+
+Reference parity: Harp's SGD-MF (ml/java sgd/SGDCollectiveMapper.java:54 and the
+DAAL-2019 variant experimental/daal_sgd/SGDDaalCollectiveMapper.java:75 — BASELINE's
+"harp-daal SGD-MF"). The reference design: rating rows are data-local, the item
+factor matrix H is split into ``numModelSlices`` tables that ring-rotate among
+workers (Rotator, dymoro/Rotator.java:30); within each rotation hop a timer-bounded
+``Scheduler`` (dymoro/Scheduler.java:85-160) randomly schedules (row-split,
+col-slice) blocks onto threads running asynchronous SGD point updates.
+
+TPU-native re-expression:
+
+* **Rotation** is a ``ppermute`` ring schedule (`collectives.rotation.rotate_scan`);
+  after W hops every H block has visited every worker and is home again. The whole
+  multi-epoch loop is ONE compiled XLA program.
+* **The timer-bounded async scheduler** is host-driven and data-dependent — hostile
+  to XLA (SURVEY §7 "hard parts"). Reformulated as **bounded staleness**: each hop
+  runs a fixed number of mini-batch SGD steps over that (worker, block) bucket of
+  ratings. Convergence-equivalent, not step-equivalent; Harp itself only claims
+  statistical semantics for its racy Hogwild-style updates.
+* **Sparsity** becomes static-shape bucketing: ratings are pre-sorted on the host
+  into a (W workers × W column-blocks) grid of padded COO buckets, so the device
+  program is fully static. Scatter-adds on factor rows use ``.at[].add`` which XLA
+  lowers to efficient on-chip scatters; the inner dot products are batched on the
+  MXU.
+
+RMSE per epoch is accumulated on the fly (pre-update residuals) and combined with an
+allreduce — the reference's test-RMSE allreduce (SGDCollectiveMapper.java:615-641).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from harp_tpu.collectives import lax_ops, rotation
+from harp_tpu.session import HarpSession
+
+
+@dataclasses.dataclass(frozen=True)
+class SGDMFConfig:
+    """Mirrors the reference CLI (r, lambda, epsilon/lr, numIterations,
+    numModelSlices → here the slice count is the worker count by construction)."""
+
+    rank: int = 16
+    lam: float = 0.05          # L2 regularization (reference: lambda)
+    lr: float = 0.05           # learning rate (reference: epsilon)
+    epochs: int = 10
+    minibatches_per_hop: int = 4  # bounded-staleness stand-in for the dymoro timer
+
+
+def bucketize(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    num_workers: int,
+    num_rows: int,
+    num_cols: int,
+    minibatches: int,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int, int]:
+    """Host-side layout: COO ratings → (W, W, M) padded buckets.
+
+    Bucket (w, b) holds the ratings whose row lives on worker w and whose column
+    lives in H block b, with row/col indices localized to the block. This replaces
+    the reference's regroup of VSets (SGDCollectiveMapper regroup-vw:384): the
+    shuffle happens once on the host, the device program is static.
+    """
+    w = num_workers
+    rpw = -(-num_rows // w)        # rows per worker (ceil)
+    cpb = -(-num_cols // w)        # cols per block
+    owner = rows // rpw
+    block = cols // cpb
+    m = 0
+    idx_lists = [[None] * w for _ in range(w)]
+    for wi in range(w):
+        for bi in range(w):
+            sel = np.flatnonzero((owner == wi) & (block == bi))
+            idx_lists[wi][bi] = sel
+            m = max(m, sel.size)
+    m = max(m, 1)
+    m = -(-m // minibatches) * minibatches   # pad so hops split evenly
+    r_idx = np.zeros((w, w, m), np.int32)
+    c_idx = np.zeros((w, w, m), np.int32)
+    val = np.zeros((w, w, m), np.float32)
+    mask = np.zeros((w, w, m), np.float32)
+    for wi in range(w):
+        for bi in range(w):
+            sel = idx_lists[wi][bi]
+            k = sel.size
+            r_idx[wi, bi, :k] = rows[sel] - wi * rpw
+            c_idx[wi, bi, :k] = cols[sel] - bi * cpb
+            val[wi, bi, :k] = vals[sel]
+            mask[wi, bi, :k] = 1.0
+    return r_idx, c_idx, val, mask, rpw, cpb
+
+
+class SGDMF:
+    """Distributed SGD matrix factorization over a HarpSession mesh."""
+
+    def __init__(self, session: HarpSession, config: SGDMFConfig):
+        self.session = session
+        self.config = config
+        self._compiled = {}       # (w, nmb, mbs) -> compiled SPMD program
+
+    def _build(self, w: int, nmb: int, mbs: int):
+        cfg = self.config
+        lr, lam = cfg.lr, cfg.lam
+
+        def fit_fn(r_idx, c_idx, val, mask, w0, h0):
+            # Sharded bucket blocks arrive as (1, W, M): leading axis is this
+            # worker's shard of the worker axis.
+            r_idx, c_idx, val, mask = r_idx[0], c_idx[0], val[0], mask[0]
+
+            def hop_body(carry, h_block, t):
+                w_local, sse, cnt = carry
+                wid = lax_ops.worker_id()
+                src = (wid - t) % w                 # home worker of resident block
+                r = jnp.take(r_idx, src, axis=0).reshape(nmb, mbs)
+                c = jnp.take(c_idx, src, axis=0).reshape(nmb, mbs)
+                v = jnp.take(val, src, axis=0).reshape(nmb, mbs)
+                msk = jnp.take(mask, src, axis=0).reshape(nmb, mbs)
+
+                def mb_step(state, xs):
+                    wl, hb, sse, cnt = state
+                    rm, cm, vm, mm = xs
+                    wr = wl[rm]                      # (mbs, K)
+                    hc = hb[cm]
+                    pred = jnp.sum(wr * hc, axis=-1)
+                    err = (vm - pred) * mm
+                    wl = wl.at[rm].add(
+                        lr * (err[:, None] * hc - lam * wr * mm[:, None]))
+                    hb = hb.at[cm].add(
+                        lr * (err[:, None] * wr - lam * hc * mm[:, None]))
+                    return (wl, hb, sse + jnp.sum(err * err), cnt + jnp.sum(mm)), None
+
+                (w_local, h_block, sse, cnt), _ = jax.lax.scan(
+                    mb_step, (w_local, h_block, sse, cnt), (r, c, v, msk))
+                return (w_local, sse, cnt), h_block
+
+            def epoch(state, _):
+                w_local, h_block = state
+                (w_local, sse, cnt), h_block = rotation.rotate_scan(
+                    hop_body, (w_local, jnp.zeros(()), jnp.zeros(())), h_block, w)
+                sse = jax.lax.psum(sse, lax_ops.WORKERS)
+                cnt = jax.lax.psum(cnt, lax_ops.WORKERS)
+                return (w_local, h_block), jnp.sqrt(sse / jnp.maximum(cnt, 1.0))
+
+            (w_local, h_block), rmse = jax.lax.scan(
+                epoch, (w0, h0), None, length=cfg.epochs)
+            return w_local, h_block, rmse
+
+        sess = self.session
+        return sess.spmd(
+            fit_fn,
+            in_specs=(sess.shard(), sess.shard(), sess.shard(), sess.shard(),
+                      sess.shard(), sess.shard()),
+            out_specs=(sess.shard(), sess.shard(), sess.replicate()),
+        )
+
+    def fit(self, rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
+            num_rows: int, num_cols: int, seed: int = 0
+            ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Train; returns (W (num_rows, K), H (num_cols, K), rmse-per-epoch)."""
+        cfg = self.config
+        sess = self.session
+        w = sess.num_workers
+        r_idx, c_idx, val, mask, rpw, cpb = bucketize(
+            rows, cols, vals, w, num_rows, num_cols, cfg.minibatches_per_hop)
+        m = r_idx.shape[2]
+        nmb = cfg.minibatches_per_hop
+        mbs = m // nmb
+        key = (w, nmb, mbs)
+        if key not in self._compiled:
+            self._compiled[key] = self._build(w, nmb, mbs)
+        fit = self._compiled[key]
+
+        rng = np.random.default_rng(seed)
+        scale = 1.0 / np.sqrt(cfg.rank)
+        w0 = (scale * rng.standard_normal((w * rpw, cfg.rank))).astype(np.float32)
+        h0 = (scale * rng.standard_normal((w * cpb, cfg.rank))).astype(np.float32)
+
+        out_w, out_h, rmse = fit(
+            sess.scatter(r_idx), sess.scatter(c_idx), sess.scatter(val),
+            sess.scatter(mask), sess.scatter(w0), sess.scatter(h0))
+        return (np.asarray(out_w)[:num_rows], np.asarray(out_h)[:num_cols],
+                np.asarray(rmse))
+
+
+def numpy_rmse(w_f: np.ndarray, h_f: np.ndarray, rows, cols, vals) -> float:
+    pred = np.einsum("ij,ij->i", w_f[rows], h_f[cols])
+    return float(np.sqrt(np.mean((vals - pred) ** 2)))
