@@ -28,8 +28,8 @@ def _find_lib() -> Optional[ctypes.CDLL]:
     _TRIED = True
     here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     for cand in (
+        os.environ.get("HARP_NATIVE_LIB", ""),   # explicit override wins
         os.path.join(here, "native", "libharp_native.so"),
-        os.environ.get("HARP_NATIVE_LIB", ""),
     ):
         if cand and os.path.exists(cand):
             try:
@@ -59,6 +59,12 @@ def _configure(lib: ctypes.CDLL) -> None:
                                    ctypes.POINTER(ctypes.c_float), ctypes.c_longlong]
 
 
+def reset() -> None:
+    """Forget the cached probe (call after building the library)."""
+    global _LIB, _TRIED
+    _LIB, _TRIED = None, False
+
+
 def available() -> bool:
     return _find_lib() is not None
 
@@ -82,6 +88,8 @@ def parse_csv(path: str, sep: str = ",") -> Optional[np.ndarray]:
 
 def parse_coo(path: str, sep: str = " "
               ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    if sep not in (" ", "\t"):
+        return None  # native parser tokenizes by whitespace only; numpy fallback
     lib = _find_lib()
     if lib is None:
         return None

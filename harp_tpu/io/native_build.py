@@ -1,0 +1,47 @@
+"""Build the native loader: ``python -m harp_tpu.io.native_build``.
+
+Equivalent to ``make -C native``; exists so the framework is buildable without
+make. Reference parity: Harp shipped its native libs prebuilt and dlopen'd them at
+worker startup (data_aux/Initialize.loadDistributedLibs:67-84); we build from
+source on the host instead.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+
+
+def native_dir() -> str:
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(repo, "native")
+
+
+def lib_path() -> str:
+    return os.path.join(native_dir(), "libharp_native.so")
+
+
+def build(force: bool = False) -> str | None:
+    """Compile libharp_native.so; returns the path, or None if no compiler."""
+    src = os.path.join(native_dir(), "loader.cpp")
+    out = lib_path()
+    if not force and os.path.exists(out) and (
+            os.path.getmtime(out) >= os.path.getmtime(src)):
+        return out
+    cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("c++")
+    if cxx is None:
+        return None
+    cmd = [cxx, "-O3", "-std=c++17", "-fPIC", "-pthread", "-Wall", "-shared",
+           "-o", out, src]
+    subprocess.run(cmd, check=True)
+    return out
+
+
+if __name__ == "__main__":
+    path = build(force="--force" in sys.argv)
+    if path is None:
+        print("no C++ compiler found; native loader unavailable", file=sys.stderr)
+        sys.exit(1)
+    print(path)

@@ -74,27 +74,29 @@ def bucketize(
     cpb = -(-num_cols // w)        # cols per block
     owner = rows // rpw
     block = cols // cpb
-    m = 0
-    idx_lists = [[None] * w for _ in range(w)]
-    for wi in range(w):
-        for bi in range(w):
-            sel = np.flatnonzero((owner == wi) & (block == bi))
-            idx_lists[wi][bi] = sel
-            m = max(m, sel.size)
-    m = max(m, 1)
+    # One sort-based pass: order entries by (owner, block), then lay each bucket
+    # out contiguously — O(nnz log nnz), not O(W^2 * nnz).
+    bucket = owner.astype(np.int64) * w + block
+    order = np.argsort(bucket, kind="stable")
+    counts = np.bincount(bucket, minlength=w * w)
+    m = max(int(counts.max()), 1) if counts.size else 1
     m = -(-m // minibatches) * minibatches   # pad so hops split evenly
     r_idx = np.zeros((w, w, m), np.int32)
     c_idx = np.zeros((w, w, m), np.int32)
     val = np.zeros((w, w, m), np.float32)
     mask = np.zeros((w, w, m), np.float32)
-    for wi in range(w):
-        for bi in range(w):
-            sel = idx_lists[wi][bi]
-            k = sel.size
-            r_idx[wi, bi, :k] = rows[sel] - wi * rpw
-            c_idx[wi, bi, :k] = cols[sel] - bi * cpb
-            val[wi, bi, :k] = vals[sel]
-            mask[wi, bi, :k] = 1.0
+    starts = np.concatenate([[0], np.cumsum(counts)])
+    rs, cs, vs = rows[order], cols[order], vals[order]
+    for b in range(w * w):
+        lo, hi = starts[b], starts[b + 1]
+        if lo == hi:
+            continue
+        wi, bi = divmod(b, w)
+        k = hi - lo
+        r_idx[wi, bi, :k] = rs[lo:hi] - wi * rpw
+        c_idx[wi, bi, :k] = cs[lo:hi] - bi * cpb
+        val[wi, bi, :k] = vs[lo:hi]
+        mask[wi, bi, :k] = 1.0
     return r_idx, c_idx, val, mask, rpw, cpb
 
 
