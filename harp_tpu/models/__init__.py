@@ -1,0 +1,14 @@
+"""Algorithm library — the ml/java + ml/daal + contrib application inventory,
+re-built TPU-native. Import the submodule you need; nothing heavy is imported
+eagerly (each model compiles its own SPMD program on first use).
+
+Families (reference dirs → modules):
+  kmeans (5 comm variants)          → models.kmeans
+  sgd/ + experimental daal_sgd      → models.sgd_mf
+  daal_cov/pca/mom/qr/svd/...       → models.stats
+  daal_linreg/daal_ridgereg         → models.linear
+  daal_naive                        → models.naive_bayes
+  contrib/mlr                       → models.logistic
+  daal_svm + contrib/svm            → models.svm
+  daal_knn                          → models.knn
+"""

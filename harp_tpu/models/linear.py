@@ -1,0 +1,83 @@
+"""Linear & ridge regression — normal equations over the mesh.
+
+Reference parity: daal_linreg + daal_ridgereg (SURVEY §2.7): DAAL distributed
+linear regression trains by accumulating per-node partial (X'X, X'y) products
+(Step1Local) and solving on the master (Step2Master); Harp shipped the partials
+with a gather. TPU-native: the partial products are one psum each, the (D, D)
+solve runs replicated on every chip, and the whole fit is a single compiled SPMD
+program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from harp_tpu.ops import linalg
+from harp_tpu.parallel.mesh import WORKERS
+from harp_tpu.session import HarpSession
+
+
+def normal_equations(x: jax.Array, y: jax.Array, l2: float = 0.0,
+                     fit_intercept: bool = True, axis_name: str = WORKERS
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """SPMD solve of (X'X + λI) β = X'y with row-sharded x (N/W, D), y (N/W, T).
+
+    Returns (beta (D, T), intercept (T,)). The intercept is recovered from global
+    means (never regularized), matching DAAL's interceptFlag semantics.
+    """
+    n = jax.lax.psum(jnp.asarray(x.shape[0], jnp.float32), axis_name)
+    sx = jax.lax.psum(jnp.sum(x, axis=0), axis_name)
+    sy = jax.lax.psum(jnp.sum(y, axis=0), axis_name)
+    gram = linalg.psum_gram(x, x, axis_name)
+    xty = linalg.psum_gram(x, y, axis_name)
+    d = x.shape[1]
+    if fit_intercept:
+        mx, my = sx / n, sy / n
+        gram = gram - n * jnp.outer(mx, mx)
+        xty = xty - n * jnp.outer(mx, my)
+    a = gram + l2 * jnp.eye(d, dtype=gram.dtype)
+    beta = jax.scipy.linalg.solve(a, xty, assume_a="pos")
+    intercept = (my - mx @ beta) if fit_intercept else jnp.zeros(y.shape[1],
+                                                                 x.dtype)
+    return beta, intercept
+
+
+class LinearRegression:
+    """daal_linreg (l2=0) / daal_ridgereg (l2>0) over a HarpSession."""
+
+    def __init__(self, session: HarpSession, l2: float = 0.0,
+                 fit_intercept: bool = True):
+        self.session = session
+        self.l2 = l2
+        self.fit_intercept = fit_intercept
+        self.beta: Optional[np.ndarray] = None
+        self.intercept: Optional[np.ndarray] = None
+        sess = session
+        self._fn = sess.spmd(
+            lambda a, b: normal_equations(a, b, self.l2, self.fit_intercept),
+            in_specs=(sess.shard(), sess.shard()),
+            out_specs=(sess.replicate(), sess.replicate()))
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> "LinearRegression":
+        if y.ndim == 1:
+            y = y[:, None]
+        sess = self.session
+        beta, intercept = self._fn(sess.scatter(jnp.asarray(x)),
+                                   sess.scatter(jnp.asarray(y)))
+        self.beta, self.intercept = np.asarray(beta), np.asarray(intercept)
+        return self
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        return x @ self.beta + self.intercept
+
+
+class RidgeRegression(LinearRegression):
+    """daal_ridgereg: alias with a required penalty."""
+
+    def __init__(self, session: HarpSession, l2: float = 1.0,
+                 fit_intercept: bool = True):
+        super().__init__(session, l2=l2, fit_intercept=fit_intercept)

@@ -1,0 +1,102 @@
+"""Naive Bayes classifiers — distributed sufficient statistics.
+
+Reference parity: daal_naive (SURVEY §2.7) wrapped DAAL's multinomial naive Bayes
+(DistributedStep1Local partial class/feature counts + Step2Master merge). The
+TPU-native training pass is a one-hot matmul (MXU) producing per-class feature
+sums, combined with one psum; a Gaussian variant covers continuous features (the
+reference reached it through DAAL batch kernels).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from harp_tpu.parallel.mesh import WORKERS
+from harp_tpu.session import HarpSession
+
+
+def _class_stats(x: jax.Array, y: jax.Array, num_classes: int,
+                 with_sumsq: bool = True, axis_name: str = WORKERS):
+    """psum'd (class counts (C,), per-class feature sums (C, D)[, sumsq (C, D)]).
+
+    ``with_sumsq=False`` skips the squared-sum matmul+psum (MultinomialNB doesn't
+    need it; only GaussianNB pays for variances).
+    """
+    onehot = jax.nn.one_hot(y, num_classes, dtype=x.dtype)        # (N, C)
+    sums = jax.lax.dot_general(onehot, x, (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+    counts = jnp.sum(onehot, axis=0)
+    out = [jax.lax.psum(counts, axis_name), jax.lax.psum(sums, axis_name)]
+    if with_sumsq:
+        sumsq = jax.lax.dot_general(onehot, x * x, (((0,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+        out.append(jax.lax.psum(sumsq, axis_name))
+    return tuple(out)
+
+
+@dataclasses.dataclass
+class MultinomialNB:
+    """daal_naive parity: multinomial NB for nonnegative count features."""
+
+    session: HarpSession
+    num_classes: int
+    alpha: float = 1.0          # Lidstone smoothing
+    log_prior: Optional[np.ndarray] = None
+    log_prob: Optional[np.ndarray] = None
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> "MultinomialNB":
+        sess = self.session
+        fn = sess.spmd(
+            lambda a, b: _class_stats(a, b, self.num_classes, with_sumsq=False),
+            in_specs=(sess.shard(), sess.shard()),
+            out_specs=(sess.replicate(),) * 2)
+        counts, sums = fn(sess.scatter(jnp.asarray(x, jnp.float32)),
+                          sess.scatter(jnp.asarray(y)))
+        counts, sums = np.asarray(counts), np.asarray(sums)
+        self.log_prior = np.log(np.maximum(counts, 1e-12) / counts.sum())
+        smoothed = sums + self.alpha
+        self.log_prob = np.log(smoothed / smoothed.sum(axis=1, keepdims=True))
+        return self
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        scores = x @ self.log_prob.T + self.log_prior
+        return np.argmax(scores, axis=1).astype(np.int32)
+
+
+@dataclasses.dataclass
+class GaussianNB:
+    """Gaussian NB for continuous features (DAAL batch-kernel counterpart)."""
+
+    session: HarpSession
+    num_classes: int
+    var_floor: float = 1e-6
+    log_prior: Optional[np.ndarray] = None
+    mean: Optional[np.ndarray] = None
+    var: Optional[np.ndarray] = None
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> "GaussianNB":
+        sess = self.session
+        fn = sess.spmd(
+            lambda a, b: _class_stats(a, b, self.num_classes),
+            in_specs=(sess.shard(), sess.shard()),
+            out_specs=(sess.replicate(),) * 3)
+        counts, sums, sumsq = [np.asarray(o) for o in fn(
+            sess.scatter(jnp.asarray(x, jnp.float32)),
+            sess.scatter(jnp.asarray(y)))]
+        n = np.maximum(counts, 1.0)[:, None]
+        self.mean = sums / n
+        self.var = np.maximum(sumsq / n - self.mean ** 2, self.var_floor)
+        self.log_prior = np.log(np.maximum(counts, 1e-12) / counts.sum())
+        return self
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        # log N(x | mean_c, var_c) summed over features, per class
+        x_ = x[:, None, :]
+        ll = -0.5 * (np.log(2 * np.pi * self.var)
+                     + (x_ - self.mean) ** 2 / self.var).sum(-1)
+        return np.argmax(ll + self.log_prior, axis=1).astype(np.int32)

@@ -1,0 +1,155 @@
+"""Statistics / decomposition model family — the ml/daal dense-analytics suite.
+
+Reference parity (SURVEY §2.7): daal_cov/densedistri, daal_pca/cordensedistr +
+svddensedistr, daal_mom, daal_normalization, daal_qr, daal_svd, daal_cholesky,
+daal_quantile, daal_sorting, daal_outlier. Each reference family = a Launcher + a
+CollectiveMapper gluing Harp collectives around DAAL Step1Local/Step2Master
+kernels; here each is a thin session wrapper around ``harp_tpu.ops.linalg`` — one
+compiled SPMD program, data row-sharded over the worker mesh.
+
+All ``fit``/``transform`` methods accept host numpy arrays whose row count must be
+divisible by the worker count (loaders pad at ingest).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from harp_tpu.ops import linalg
+from harp_tpu.session import HarpSession
+
+
+class _SPMDWrapper:
+    def __init__(self, session: HarpSession):
+        self.session = session
+        self._fns = {}   # compiled-program cache: key -> jitted callable
+
+    def _compile(self, key, fn, n_out_rep: int, extra_sharded_out: int = 0):
+        if key in self._fns:
+            return self._fns[key]
+        sess = self.session
+        out_specs = tuple([sess.shard()] * extra_sharded_out
+                          + [sess.replicate()] * n_out_rep)
+        if len(out_specs) == 1:
+            out_specs = out_specs[0]
+        compiled = sess.spmd(fn, in_specs=(sess.shard(),), out_specs=out_specs)
+        self._fns[key] = compiled
+        return compiled
+
+
+class Covariance(_SPMDWrapper):
+    """daal_cov: distributed covariance + mean."""
+
+    def compute(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        fn = self._compile("cov", lambda a: linalg.covariance(a), 2)
+        cov, mean = fn(self.session.scatter(jnp.asarray(x)))
+        return np.asarray(cov), np.asarray(mean)
+
+
+class LowOrderMoments(_SPMDWrapper):
+    """daal_mom: the full moments result set."""
+
+    def compute(self, x: np.ndarray) -> linalg.Moments:
+        fn = self._compile("mom", lambda a: tuple(linalg.moments(a)), 10)
+        out = fn(self.session.scatter(jnp.asarray(x)))
+        return linalg.Moments(*[np.asarray(o) for o in out])
+
+
+class PCA(_SPMDWrapper):
+    """daal_pca/cordensedistr: correlation-method PCA."""
+
+    def fit(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        fn = self._compile("pca", lambda a: linalg.pca(a), 3)
+        w, comps, mean = fn(self.session.scatter(jnp.asarray(x)))
+        return np.asarray(w), np.asarray(comps), np.asarray(mean)
+
+
+class ZScore(_SPMDWrapper):
+    """daal_normalization (z-score): per-column standardization by global stats."""
+
+    def transform(self, x: np.ndarray) -> np.ndarray:
+        fn = self._compile("zscore", lambda a: linalg.zscore(a), 0, extra_sharded_out=1)
+        return np.asarray(fn(self.session.scatter(jnp.asarray(x))))
+
+
+class MinMax(_SPMDWrapper):
+    """daal_normalization (min-max)."""
+
+    def __init__(self, session: HarpSession, lo: float = 0.0, hi: float = 1.0):
+        super().__init__(session)
+        self.lo, self.hi = lo, hi
+
+    def transform(self, x: np.ndarray) -> np.ndarray:
+        fn = self._compile("minmax", lambda a: linalg.minmax(a, self.lo, self.hi),
+                           0, extra_sharded_out=1)
+        return np.asarray(fn(self.session.scatter(jnp.asarray(x))))
+
+
+class QR(_SPMDWrapper):
+    """daal_qr: distributed tall-skinny QR. Returns (Q (N, D), R (D, D))."""
+
+    def compute(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        sess = self.session
+        if "qr" not in self._fns:
+            self._fns["qr"] = sess.spmd(
+                lambda a: linalg.tsqr(a), in_specs=(sess.shard(),),
+                out_specs=(sess.shard(), sess.replicate()))
+        q, r = self._fns["qr"](sess.scatter(jnp.asarray(x)))
+        return np.asarray(q), np.asarray(r)
+
+
+class SVD(_SPMDWrapper):
+    """daal_svd: distributed SVD of a tall matrix. Returns (U (N, D), s, V^T)."""
+
+    def compute(self, x: np.ndarray):
+        sess = self.session
+        if "svd" not in self._fns:
+            self._fns["svd"] = sess.spmd(
+                lambda a: linalg.svd_tall(a), in_specs=(sess.shard(),),
+                out_specs=(sess.shard(), sess.replicate(), sess.replicate()))
+        u, s, vt = self._fns["svd"](sess.scatter(jnp.asarray(x)))
+        return np.asarray(u), np.asarray(s), np.asarray(vt)
+
+
+class Cholesky(_SPMDWrapper):
+    """daal_cholesky on the distributed gram matrix X'X."""
+
+    def compute(self, x: np.ndarray) -> np.ndarray:
+        fn = self._compile("chol", lambda a: linalg.cholesky_gram(a), 1)
+        return np.asarray(fn(self.session.scatter(jnp.asarray(x))))
+
+
+class Quantiles(_SPMDWrapper):
+    """daal_quantile: per-column quantiles of the full dataset."""
+
+    def compute(self, x: np.ndarray, qs) -> np.ndarray:
+        qs_arr = jnp.asarray(qs, jnp.float32)
+        key = ("quantiles", tuple(np.asarray(qs).tolist()))
+        fn = self._compile(key, lambda a: linalg.quantiles(a, qs_arr), 1)
+        return np.asarray(fn(self.session.scatter(jnp.asarray(x))))
+
+
+class Sorting(_SPMDWrapper):
+    """daal_sorting: column-wise sort of all rows."""
+
+    def compute(self, x: np.ndarray) -> np.ndarray:
+        fn = self._compile("sort", lambda a: linalg.distributed_sort(a), 1)
+        return np.asarray(fn(self.session.scatter(jnp.asarray(x))))
+
+
+class OutlierDetection(_SPMDWrapper):
+    """daal_outlier: multivariate Mahalanobis outlier flags per row."""
+
+    def __init__(self, session: HarpSession, threshold: float = 3.0):
+        super().__init__(session)
+        self.threshold = threshold
+
+    def compute(self, x: np.ndarray) -> np.ndarray:
+        fn = self._compile(
+            "outlier", lambda a: linalg.mahalanobis_outliers(a, self.threshold),
+            0, extra_sharded_out=1)
+        return np.asarray(fn(self.session.scatter(jnp.asarray(x))))

@@ -1,0 +1,36 @@
+"""Kernel functions — Gram-matrix kernels on the MXU.
+
+Reference parity: daal_kernel_func (SURVEY §2.7; also experimental/
+daal_kernel_func) wrapped DAAL's linear and RBF kernel-function primitives. These
+are the building blocks for kernel SVM prediction and kernel methods generally.
+
+TPU-native: each kernel is a batched matmul expression; for row-sharded inputs use
+them inside shard_map — ``linear_kernel(x_block, z)`` yields the local Gram block
+and an all_gather reassembles the full matrix when needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from harp_tpu.ops import distance
+
+
+def linear_kernel(x: jax.Array, z: jax.Array, k: float = 1.0,
+                  b: float = 0.0) -> jax.Array:
+    """K(x, z) = k·⟨x, z⟩ + b (DAAL kernel_function.linear)."""
+    xz = jax.lax.dot_general(x, z, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    return k * xz + b
+
+
+def rbf_kernel(x: jax.Array, z: jax.Array, sigma: float = 1.0) -> jax.Array:
+    """K(x, z) = exp(−‖x−z‖² / (2σ²)) (DAAL kernel_function.rbf)."""
+    return jnp.exp(-distance.pairwise_sq_dist(x, z) / (2.0 * sigma * sigma))
+
+
+def polynomial_kernel(x: jax.Array, z: jax.Array, scale: float = 1.0,
+                      shift: float = 0.0, degree: int = 3) -> jax.Array:
+    """K(x, z) = (scale·⟨x, z⟩ + shift)^degree."""
+    return linear_kernel(x, z, scale, shift) ** degree

@@ -1,0 +1,93 @@
+"""Regression/classification family tests (daal_linreg/ridge/naive/svm/knn +
+contrib/mlr parity) — all checked against plain-numpy references."""
+
+import numpy as np
+import pytest
+
+from harp_tpu.io import datagen
+from harp_tpu.models import knn, linear, logistic, naive_bayes, svm
+
+
+def test_linear_regression_recovers_beta(session):
+    x, y, beta = datagen.regression_data(256, 10, num_targets=2, seed=5,
+                                         noise=0.001)
+    model = linear.LinearRegression(session).fit(x, y)
+    np.testing.assert_allclose(model.beta, beta, atol=0.01)
+    pred = model.predict(x)
+    assert np.sqrt(np.mean((pred - y) ** 2)) < 0.01
+
+
+def test_ridge_matches_numpy_closed_form(session):
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((128, 6)).astype(np.float32)
+    y = rng.standard_normal((128, 1)).astype(np.float32)
+    lam = 2.5
+    model = linear.RidgeRegression(session, l2=lam, fit_intercept=False).fit(x, y)
+    ref = np.linalg.solve(x.T @ x + lam * np.eye(6), x.T @ y)
+    np.testing.assert_allclose(model.beta, ref, atol=1e-3)
+
+
+def test_linear_regression_intercept(session):
+    rng = np.random.default_rng(8)
+    x = rng.standard_normal((160, 4)).astype(np.float32)
+    y = (x @ np.array([1.0, -2.0, 0.5, 3.0], np.float32) + 7.0)[:, None]
+    model = linear.LinearRegression(session).fit(x, y)
+    np.testing.assert_allclose(model.intercept, [7.0], atol=1e-2)
+
+
+def test_multinomial_nb(session):
+    rng = np.random.default_rng(4)
+    # class c has elevated counts in feature block c
+    n, d, c = 240, 12, 3
+    y = rng.integers(0, c, n).astype(np.int32)
+    x = rng.poisson(1.0, (n, d)).astype(np.float32)
+    for ci in range(c):
+        x[y == ci, ci * 4:(ci + 1) * 4] += rng.poisson(6.0, ((y == ci).sum(), 4))
+    model = naive_bayes.MultinomialNB(session, num_classes=c).fit(x, y)
+    acc = (model.predict(x) == y).mean()
+    assert acc > 0.9
+
+
+def test_gaussian_nb(session):
+    x, y = datagen.classification_data(320, 8, 3, seed=6)
+    # shift class means apart so GNB is applicable
+    for c in range(3):
+        x[y == c] += 3.0 * c
+    model = naive_bayes.GaussianNB(session, num_classes=3).fit(x, y)
+    assert (model.predict(x) == y).mean() > 0.9
+
+
+def test_mlr_converges(session):
+    x, y = datagen.classification_data(400, 10, 4, seed=9)
+    cfg = logistic.MLRConfig(num_classes=4, lr=0.5, l2=1e-4, iterations=150)
+    model = logistic.MLR(session, cfg)
+    losses = model.fit(x, y)
+    assert losses[-1] < 0.5 * losses[0]
+    assert (model.predict(x) == y).mean() > 0.9
+
+
+def test_linear_svm(session):
+    rng = np.random.default_rng(12)
+    n = 320
+    w_true = np.array([1.5, -2.0, 0.7, 0.0, 1.0], np.float32)
+    x = rng.standard_normal((n, 5)).astype(np.float32)
+    y = (x @ w_true + 0.3 > 0).astype(np.int32)
+    model = svm.LinearSVM(session, svm.SVMConfig(c=10.0, lr=0.05,
+                                                 iterations=300))
+    objs = model.fit(x, y)
+    assert objs[-1] < objs[0]
+    assert (model.predict(x) == y).mean() > 0.95
+
+
+def test_knn(session):
+    x, y = datagen.classification_data(400, 6, 3, seed=20)
+    for c in range(3):
+        x[y == c] += 4.0 * c          # separable clusters
+    model = knn.KNNClassifier(session, k=5, num_classes=3).fit(x, y)
+    queries = x[:40]
+    pred = model.predict(queries)
+    assert (pred == y[:40]).mean() > 0.95
+    dists, labels = model.kneighbors(queries)
+    assert dists.shape == (40, 5) and labels.shape == (40, 5)
+    # nearest neighbor of a training point is itself (distance ~0)
+    assert np.allclose(dists[:, 0], 0.0, atol=1e-3)

@@ -1,0 +1,116 @@
+"""Dense analytics suite vs numpy references (daal_cov/pca/mom/qr/svd/... parity)."""
+
+import numpy as np
+import pytest
+
+from harp_tpu.models import stats
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(17)
+    x = rng.standard_normal((160, 12)).astype(np.float32)
+    x = x @ rng.standard_normal((12, 12)).astype(np.float32)  # correlated cols
+    return x
+
+
+def test_covariance(session, data):
+    cov, mean = stats.Covariance(session).compute(data)
+    np.testing.assert_allclose(mean, data.mean(0), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(cov, np.cov(data, rowvar=False), rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_moments(session, data):
+    m = stats.LowOrderMoments(session).compute(data)
+    assert m.count == data.shape[0]
+    np.testing.assert_allclose(m.mean, data.mean(0), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(m.variance, data.var(0, ddof=1), rtol=1e-3,
+                               atol=1e-3)
+    np.testing.assert_allclose(m.minimum, data.min(0), rtol=1e-6)
+    np.testing.assert_allclose(m.maximum, data.max(0), rtol=1e-6)
+
+
+def test_pca_matches_numpy_eigh(session, data):
+    w, comps, mean = stats.PCA(session).fit(data)
+    corr = np.corrcoef(data, rowvar=False)
+    w_ref = np.sort(np.linalg.eigvalsh(corr))[::-1]
+    np.testing.assert_allclose(w, w_ref, rtol=1e-3, atol=1e-3)
+    # components are orthonormal rows
+    np.testing.assert_allclose(comps @ comps.T, np.eye(comps.shape[0]),
+                               atol=1e-3)
+
+
+def test_zscore_and_minmax(session, data):
+    z = stats.ZScore(session).transform(data)
+    np.testing.assert_allclose(z.mean(0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(z.std(0, ddof=1), 1.0, atol=1e-3)
+    mm = stats.MinMax(session, 0.0, 1.0).transform(data)
+    np.testing.assert_allclose(mm.min(0), 0.0, atol=1e-6)
+    np.testing.assert_allclose(mm.max(0), 1.0, atol=1e-6)
+
+
+def test_qr_reconstructs(session, data):
+    q, r = stats.QR(session).compute(data)
+    np.testing.assert_allclose(q @ r, data, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(q.T @ q, np.eye(q.shape[1]), atol=1e-3)
+    assert np.all(np.diag(r) >= 0)   # sign-normalized
+    assert np.allclose(r, np.triu(r), atol=1e-5)
+
+
+def test_svd_matches_numpy(session, data):
+    u, s, vt = stats.SVD(session).compute(data)
+    s_ref = np.linalg.svd(data, compute_uv=False)
+    np.testing.assert_allclose(s, s_ref, rtol=1e-3)
+    np.testing.assert_allclose(u @ np.diag(s) @ vt, data, rtol=1e-3, atol=1e-3)
+
+
+def test_cholesky(session, data):
+    l = stats.Cholesky(session).compute(data)
+    np.testing.assert_allclose(l @ l.T, data.T @ data, rtol=1e-2, atol=1e-1)
+
+
+def test_quantiles_and_sort(session, data):
+    qs = [0.1, 0.5, 0.9]
+    q = stats.Quantiles(session).compute(data, qs)
+    np.testing.assert_allclose(q, np.quantile(data, qs, axis=0), rtol=1e-4,
+                               atol=1e-4)
+    s = stats.Sorting(session).compute(data)
+    np.testing.assert_allclose(s, np.sort(data, axis=0), rtol=1e-6)
+
+
+def test_outliers(session):
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((120, 4)).astype(np.float32)
+    x[5] = 40.0   # blatant outlier
+    flags = stats.OutlierDetection(session, threshold=4.0).compute(x)
+    assert flags[5] == 1
+    assert flags.sum() <= 3
+
+
+def test_kernel_functions(session):
+    import jax.numpy as jnp
+    from harp_tpu.ops import kernels
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((10, 4)).astype(np.float32)
+    z = rng.standard_normal((6, 4)).astype(np.float32)
+    lin = np.asarray(kernels.linear_kernel(jnp.asarray(x), jnp.asarray(z)))
+    np.testing.assert_allclose(lin, x @ z.T, rtol=1e-5)
+    rbf = np.asarray(kernels.rbf_kernel(jnp.asarray(x), jnp.asarray(z), 2.0))
+    d = ((x[:, None] - z[None]) ** 2).sum(-1)
+    np.testing.assert_allclose(rbf, np.exp(-d / 8.0), rtol=1e-4, atol=1e-5)
+    poly = np.asarray(kernels.polynomial_kernel(jnp.asarray(x), jnp.asarray(z),
+                                                1.0, 1.0, 2))
+    np.testing.assert_allclose(poly, (x @ z.T + 1.0) ** 2, rtol=1e-4)
+
+
+def test_knn_k_guard(session):
+    from harp_tpu.models import knn as knn_mod
+    x = np.zeros((16, 3), np.float32)
+    y = np.zeros((16,), np.int32)
+    model = knn_mod.KNNClassifier(session, k=5)
+    try:
+        model.fit(x, y)
+        raise AssertionError("expected ValueError")
+    except ValueError as e:
+        assert "rows per worker" in str(e)
