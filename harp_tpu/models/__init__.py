@@ -11,4 +11,9 @@ Families (reference dirs → modules):
   contrib/mlr                       → models.logistic
   daal_svm + contrib/svm            → models.svm
   daal_knn                          → models.knn
+  daal_als (+ _batch)               → models.als
+  ccd/ (CCD++ MF)                   → models.ccd
+  lda/ (CGS) + contrib/lda (CVB0)   → models.lda
+  daal_nn                           → models.nn
+  daal_optimization_solvers         → models.solvers
 """

@@ -1,0 +1,140 @@
+"""Alternating least squares — explicit and implicit (confidence-weighted).
+
+Reference parity: daal_als (ALSDaalCollectiveMapper.java:49 — implicit ALS on CSR
+with DAAL's 4 distributed train steps; Harp allgather:336 + bcast of step2/step3
+partial results:396-490) and daal_als_batch.
+
+TPU-native: the factor matrices stay REPLICATED between half-iterations (they are
+small: entities × rank); each half-iteration a worker solves the normal equations
+for its shard of users (then items) as one batched Cholesky solve on the MXU, and
+one all_gather re-replicates the updated factor — DAAL's step1-4 dance collapses
+to "batched local solve + allgather". Ragged observed-item lists become padded
+(entity, max_nnz) index/value buckets (SURVEY §7 sparse-data recipe).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from harp_tpu.collectives import lax_ops
+from harp_tpu.parallel.mesh import WORKERS
+from harp_tpu.session import HarpSession
+
+
+@dataclasses.dataclass(frozen=True)
+class ALSConfig:
+    rank: int = 10
+    lam: float = 0.1            # L2 (DAAL: lambda)
+    alpha: float = 40.0         # implicit confidence weight (DAAL: alpha)
+    iterations: int = 10
+    implicit: bool = True
+
+
+def pad_csr_lists(rows, cols, vals, num_rows, num_workers):
+    """(entity → padded neighbor list): idx (R_pad, M), val (R_pad, M), mask."""
+    order = np.argsort(rows, kind="stable")
+    r, c, v = rows[order], cols[order], vals[order]
+    rpw = -(-num_rows // num_workers)
+    r_pad = rpw * num_workers
+    counts = np.bincount(r, minlength=r_pad)
+    m = max(int(counts.max()), 1)
+    idx = np.zeros((r_pad, m), np.int32)
+    val = np.zeros((r_pad, m), np.float32)
+    mask = np.zeros((r_pad, m), np.float32)
+    starts = np.concatenate([[0], np.cumsum(counts)])
+    pos = np.arange(len(r)) - starts[r]          # slot within each row bucket
+    idx[r, pos] = c
+    val[r, pos] = v
+    mask[r, pos] = 1.0
+    return idx, val, mask
+
+
+def _half_step(factor_other, idx, val, mask, cfg: ALSConfig,
+               axis_name: str = WORKERS):
+    """Solve this worker's block of one side's normal equations.
+
+    factor_other: replicated (E_other, K). idx/val/mask: this worker's padded
+    lists (E_local, M). Returns the updated local block (E_local, K).
+    """
+    k = cfg.rank
+    vi = factor_other[idx]                      # (E_local, M, K)
+    vi = vi * mask[..., None]
+    if cfg.implicit:
+        # Hu, Koren, Volinsky: A = V'V + V'(C−I)V + λI;  b = V'C·p (p=1 observed)
+        conf = cfg.alpha * val * mask          # c − 1
+        gram = jax.lax.dot_general(             # V'V over ALL entities (replicated)
+            factor_other, factor_other, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        a = gram[None] + jnp.einsum("emk,em,eml->ekl", vi, conf, vi)
+        b = jnp.einsum("emk,em->ek", vi, (1.0 + conf) * mask)
+    else:
+        # explicit: normal equations over observed entries only
+        a = jnp.einsum("emk,eml->ekl", vi, vi)
+        b = jnp.einsum("emk,em->ek", vi, val * mask)
+    a = a + cfg.lam * jnp.eye(k, dtype=a.dtype)[None]
+    return jax.scipy.linalg.solve(a, b[..., None], assume_a="pos")[..., 0]
+
+
+def _train(u_idx, u_val, u_mask, i_idx, i_val, i_mask, u0, v0, cfg: ALSConfig,
+           axis_name: str = WORKERS):
+    def iteration(carry, _):
+        u, v = carry                             # both replicated (E, K)
+        # users half-step: local block solve, then re-replicate
+        u_block = _half_step(v, u_idx, u_val, u_mask, cfg, axis_name)
+        u = lax_ops.allgather(u_block, axis_name)
+        v_block = _half_step(u, i_idx, i_val, i_mask, cfg, axis_name)
+        v = lax_ops.allgather(v_block, axis_name)
+        # monitor: explicit squared error on observed entries of the user shard
+        pred = jnp.einsum("emk,ek->em", v[u_idx] * u_mask[..., None], u_block)
+        tgt = u_val if not cfg.implicit else (u_mask * 1.0)
+        sse = jax.lax.psum(jnp.sum(u_mask * (tgt - pred) ** 2), axis_name)
+        cnt = jax.lax.psum(jnp.sum(u_mask), axis_name)
+        return (u, v), jnp.sqrt(sse / jnp.maximum(cnt, 1.0))
+
+    (u, v), rmse = jax.lax.scan(iteration, (u0, v0), None,
+                                length=cfg.iterations)
+    return u, v, rmse
+
+
+class ALS:
+    """Distributed ALS over a HarpSession mesh (daal_als parity)."""
+
+    def __init__(self, session: HarpSession, config: ALSConfig):
+        self.session = session
+        self.config = config
+        self._fns = {}
+
+    def fit(self, rows, cols, vals, num_users: int, num_items: int,
+            seed: int = 0) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Returns (U (num_users, K), V (num_items, K), rmse-per-iteration)."""
+        sess, cfg = self.session, self.config
+        w = sess.num_workers
+        u_idx, u_val, u_mask = pad_csr_lists(rows, cols, vals, num_users, w)
+        i_idx, i_val, i_mask = pad_csr_lists(cols, rows, vals, num_items, w)
+        rng = np.random.default_rng(seed)
+        scale = 1.0 / np.sqrt(cfg.rank)
+        u0 = (scale * rng.random((u_idx.shape[0], cfg.rank))).astype(np.float32)
+        v0 = (scale * rng.random((i_idx.shape[0], cfg.rank))).astype(np.float32)
+        # zero phantom padding rows: the implicit-mode gram V'V sums over ALL
+        # rows of the replicated factor, so random init there would bias the
+        # first half-iteration's normal equations
+        u0[num_users:] = 0.0
+        v0[num_items:] = 0.0
+
+        key = (u_idx.shape, i_idx.shape)
+        if key not in self._fns:
+            self._fns[key] = sess.spmd(
+                lambda a, b, c, d, e, f, g, h: _train(a, b, c, d, e, f, g, h, cfg),
+                in_specs=(sess.shard(),) * 6 + (sess.replicate(),) * 2,
+                out_specs=(sess.replicate(),) * 3)
+        u, v, rmse = self._fns[key](
+            sess.scatter(u_idx), sess.scatter(u_val), sess.scatter(u_mask),
+            sess.scatter(i_idx), sess.scatter(i_val), sess.scatter(i_mask),
+            sess.replicate_put(u0), sess.replicate_put(v0))
+        return (np.asarray(u)[:num_users], np.asarray(v)[:num_items],
+                np.asarray(rmse))

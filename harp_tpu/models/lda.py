@@ -1,0 +1,200 @@
+"""LDA via collapsed Gibbs sampling with model rotation.
+
+Reference parity: ml/java lda (LDAMPCollectiveMapper.java:51 — SparseLDA CGS with
+the word-topic table ring-rotating via Rotator:257 and doc-topic tables local;
+likelihood via allreduce:731 — BASELINE's "harp-java CGS-LDA, dynamic scheduler +
+asynchronous rotation") and contrib/lda (CVB0).
+
+TPU-native reformulation (SURVEY §7 "hard parts" — async semantics under SPMD):
+
+* Docs are sharded over workers; the word-topic count matrix is split into W
+  vocab blocks that ring-rotate (``ppermute``) — Harp's Rotator schedule.
+* Strictly sequential per-token Gibbs is hostile to SPMD, so sampling is
+  **blocked**: during a hop, every token of the resident vocab block draws its
+  topic from the CURRENT counts in parallel; count deltas are applied after the
+  block (one-hot matmuls on the MXU). This is the standard blocked/stale-count
+  approximation used by every distributed CGS (including Harp itself across
+  workers — its staleness is per-rotation too, LDAMPCollectiveMapper rotates
+  between updates); convergence is statistical, not token-sequential.
+* Topic totals n_k are refreshed by psum once per hop — bounded staleness,
+  replacing Harp's asynchronously drifting totals.
+
+Likelihood monitor: the model's per-epoch joint log-likelihood terms that depend
+on counts (word-topic part), allreduced — matching the reference's
+printLogLikelihood role rather than its exact formula.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from harp_tpu.collectives import lax_ops, rotation
+from harp_tpu.parallel.mesh import WORKERS
+from harp_tpu.session import HarpSession
+
+
+@dataclasses.dataclass(frozen=True)
+class LDAConfig:
+    """Reference CLI parity (numTopics, alpha, beta, numIterations)."""
+
+    num_topics: int = 10
+    vocab: int = 100
+    alpha: float = 0.1
+    beta: float = 0.01
+    epochs: int = 20
+
+
+def bucketize_tokens(docs: np.ndarray, num_blocks: int, vpb: int
+                     ) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Host-side layout: (D, L) tokens → (D, W, Lb) grouped by home vocab block.
+
+    Each hop then processes exactly the resident block's tokens (padded to the
+    max per-(doc, block) count Lb) instead of sampling every token every hop.
+    """
+    d, l = docs.shape
+    rows = np.arange(d)[:, None]
+    block = np.minimum(docs // vpb, num_blocks - 1)
+    counts = np.zeros((d, num_blocks), np.int64)
+    np.add.at(counts, (rows, block), 1)
+    lb = max(int(counts.max()), 1)
+    # padding slots hold each block's first word id (in-range for w_local);
+    # mask zeroes their effect on counts and sampling
+    base = (np.arange(num_blocks) * vpb).astype(docs.dtype)
+    docs_b = np.broadcast_to(base[None, :, None], (d, num_blocks, lb)).copy()
+    mask_b = np.zeros((d, num_blocks, lb), np.float32)
+    order = np.argsort(block, axis=1, kind="stable")
+    sorted_block = np.take_along_axis(block, order, axis=1)
+    sorted_docs = np.take_along_axis(docs, order, axis=1)
+    bucket_starts = np.concatenate(
+        [np.zeros((d, 1), np.int64), np.cumsum(counts, axis=1)[:, :-1]], axis=1)
+    pos = np.arange(l)[None, :] - bucket_starts[rows, sorted_block]
+    docs_b[rows, sorted_block, pos] = sorted_docs
+    mask_b[rows, sorted_block, pos] = 1.0
+    return docs_b, mask_b, lb
+
+
+class LDA:
+    """Distributed CGS-LDA over a HarpSession mesh."""
+
+    def __init__(self, session: HarpSession, config: LDAConfig):
+        self.session = session
+        self.config = config
+        self._fns = {}
+
+    def _build(self, w: int, v_pad: int, lb: int):
+        cfg = self.config
+        k = cfg.num_topics
+        vpb = v_pad // w                      # vocab per block
+
+        def fit_fn(docs_b, mask_b, z0, wt_block0, seed):
+            # docs_b/mask_b/z0: (D_local, W, Lb) — tokens pre-bucketed by home
+            # vocab block (host-side, bucketize_tokens), so each hop touches
+            # only the resident block's tokens instead of sampling all tokens
+            # and discarding (w-1)/w of the draws.
+            def hop_body(carry, wt_block, t):
+                doc_topic, z, topic_tot, key = carry
+                wid = lax_ops.worker_id()
+                src = (wid - t) % w           # home block of resident slice
+                docs_s = jnp.take(docs_b, src, axis=1)        # (D, Lb)
+                mask_s = jnp.take(mask_b, src, axis=1)
+                z_s = jnp.take(z, src, axis=1)
+                w_local = docs_s - src * vpb
+
+                # blocked Gibbs: resident-block tokens sample from current
+                # counts: p(z=k) ∝ (n_dk−cur+α)(n_wk−cur+β)/(n_k−cur+Vβ)
+                cur = (jax.nn.one_hot(z_s, k, dtype=jnp.float32)
+                       * mask_s[..., None])                   # (D, Lb, K)
+                nd = doc_topic[:, None, :] - cur              # exclude self
+                nw = wt_block[w_local] - cur
+                nk = topic_tot[None, None, :] - cur
+                logits = (jnp.log(jnp.maximum(nd + cfg.alpha, 1e-10))
+                          + jnp.log(jnp.maximum(nw + cfg.beta, 1e-10))
+                          - jnp.log(jnp.maximum(nk + cfg.vocab * cfg.beta,
+                                                1e-10)))
+                key, sub = jax.random.split(key)
+                z_new = jax.random.categorical(sub, logits, axis=-1)
+
+                # apply count deltas (one-hot matmuls on the MXU)
+                new = (jax.nn.one_hot(z_new, k, dtype=jnp.float32)
+                       * mask_s[..., None])
+                delta = new - cur                             # (D, Lb, K)
+                doc_topic = doc_topic + delta.sum(axis=1)
+                wt_block = wt_block + jax.ops.segment_sum(
+                    delta.reshape(-1, k), w_local.reshape(-1), num_segments=vpb)
+                # bounded-staleness topic totals: refresh by psum of deltas
+                topic_tot = topic_tot + jax.lax.psum(delta.sum(axis=(0, 1)),
+                                                     lax_ops.WORKERS)
+                z = jnp.where((jnp.arange(w) == src)[None, :, None],
+                              z_new[:, None, :], z)
+                return (doc_topic, z, topic_tot, key), wt_block
+
+            key = jax.random.fold_in(jax.random.PRNGKey(0),
+                                     seed + lax_ops.worker_id())
+            doc_topic = (jax.nn.one_hot(z0, k, dtype=jnp.float32)
+                         * mask_b[..., None]).sum(axis=(1, 2))
+            topic_tot = jax.lax.psum(doc_topic.sum(axis=0), lax_ops.WORKERS)
+
+            def epoch(state, _):
+                doc_topic, z, topic_tot, wt, key = state
+                (doc_topic, z, topic_tot, key), wt = rotation.rotate_scan(
+                    hop_body, (doc_topic, z, topic_tot, key), wt, w)
+                # log-likelihood proxy: Σ lgamma(n_wk+β) − Σ lgamma(n_k+Vβ)
+                ll_w = jax.lax.psum(
+                    jnp.sum(jax.scipy.special.gammaln(wt + cfg.beta)),
+                    lax_ops.WORKERS)
+                ll_k = jnp.sum(jax.scipy.special.gammaln(
+                    topic_tot + cfg.vocab * cfg.beta))
+                return (doc_topic, z, topic_tot, wt, key), ll_w - ll_k
+
+            (doc_topic, z, topic_tot, wt, key), ll = jax.lax.scan(
+                epoch, (doc_topic, z0, topic_tot, wt_block0, key), None,
+                length=cfg.epochs)
+            return doc_topic, wt, z, ll
+
+        sess = self.session
+        return sess.spmd(
+            fit_fn,
+            in_specs=(sess.shard(), sess.shard(), sess.shard(), sess.shard(),
+                      sess.replicate()),
+            out_specs=(sess.shard(), sess.shard(), sess.shard(),
+                       sess.replicate()),
+        )
+
+    def fit(self, docs: np.ndarray, seed: int = 0
+            ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Train on a (num_docs, doc_len) token matrix.
+
+        Returns (doc_topic (D, K), word_topic (V, K), log-likelihood per epoch).
+        """
+        sess, cfg = self.session, self.config
+        w = sess.num_workers
+        v_pad = -(-cfg.vocab // w) * w
+        num_docs = docs.shape[0]
+        if num_docs % w:
+            raise ValueError(f"num_docs {num_docs} must divide over {w} workers")
+
+        docs_b, mask_b, lb = bucketize_tokens(docs, w, v_pad // w)
+        rng = np.random.default_rng(seed)
+        z0 = rng.integers(0, cfg.num_topics, docs_b.shape).astype(np.int32)
+        # initial word-topic counts, laid out as W stacked vocab blocks
+        wt = np.zeros((v_pad, cfg.num_topics), np.float32)
+        np.add.at(wt, docs_b.reshape(-1),
+                  np.eye(cfg.num_topics, dtype=np.float32)[z0.reshape(-1)]
+                  * mask_b.reshape(-1, 1))
+
+        key = (w, v_pad, lb, num_docs)
+        if key not in self._fns:
+            self._fns[key] = self._build(w, v_pad, lb)
+        doc_topic, wt_out, z, ll = self._fns[key](
+            sess.scatter(jnp.asarray(docs_b, jnp.int32)),
+            sess.scatter(jnp.asarray(mask_b, jnp.float32)),
+            sess.scatter(jnp.asarray(z0)),
+            sess.scatter(jnp.asarray(wt)),
+            jnp.asarray(seed, jnp.int32))
+        return (np.asarray(doc_topic), np.asarray(wt_out)[: cfg.vocab],
+                np.asarray(ll))

@@ -1,0 +1,82 @@
+"""ALS / mini-batch NN / optimization-solver tests (daal_als, daal_nn,
+daal_optimization_solvers parity)."""
+
+import numpy as np
+import pytest
+
+from harp_tpu.io import datagen
+from harp_tpu.models import als, nn, solvers
+
+
+def test_explicit_als_converges(session):
+    rows, cols, vals = datagen.sparse_ratings(80, 64, rank=4, density=0.3,
+                                              seed=7, noise=0.01)
+    cfg = als.ALSConfig(rank=8, lam=0.05, iterations=8, implicit=False)
+    u, v, rmse = als.ALS(session, cfg).fit(rows, cols, vals, 80, 64)
+    assert rmse[-1] < 0.12
+    assert rmse[-1] < 0.5 * rmse[0]
+    pred = np.einsum("ij,ij->i", u[rows], v[cols])
+    assert np.sqrt(np.mean((vals - pred) ** 2)) < 0.12
+
+
+def test_implicit_als_ranks_observed_higher(session):
+    rng = np.random.default_rng(3)
+    # block structure: users 0-39 consume items 0-31, users 40-79 items 32-63
+    rows, cols = [], []
+    for u_ in range(80):
+        items = rng.choice(32, size=10, replace=False) + (32 if u_ >= 40 else 0)
+        rows += [u_] * 10
+        cols += list(items)
+    rows = np.array(rows, np.int32)
+    cols = np.array(cols, np.int32)
+    vals = np.ones(len(rows), np.float32)
+    cfg = als.ALSConfig(rank=6, lam=0.1, alpha=20.0, iterations=6,
+                        implicit=True)
+    u, v, _ = als.ALS(session, cfg).fit(rows, cols, vals, 80, 64)
+    scores = u @ v.T
+    in_block = scores[:40, :32].mean()
+    out_block = scores[:40, 32:].mean()
+    assert in_block > out_block + 0.2
+
+
+def test_mlp_classifier(session):
+    x, y = datagen.classification_data(640, 10, 3, seed=15)
+    cfg = nn.NNConfig(layers=(32,), num_classes=3, lr=0.2, batch_size=20,
+                      epochs=30)
+    model = nn.MLPClassifier(session, cfg)
+    losses = model.fit(x, y)
+    assert losses[-1] < 0.5 * losses[0]
+    assert (model.predict(x) == y).mean() > 0.9
+
+
+@pytest.mark.parametrize("kind,iters", [
+    ("sgd", 200), ("sgd_minibatch", 200), ("sgd_momentum", 120),
+    ("adagrad", 300), ("lbfgs", 40),
+])
+def test_solvers_minimize_mse(session, kind, iters):
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((160, 8)).astype(np.float32)
+    beta = rng.standard_normal(8).astype(np.float32)
+    y = x @ beta
+    lr = {"lbfgs": 0.5, "adagrad": 1.0}.get(kind, 0.1)
+    cfg = solvers.SolverConfig(lr=lr, iterations=iters, batch_size=10)
+    theta, losses = solvers.Solver(session, kind, cfg).minimize(
+        solvers.mse_objective, x, y, np.zeros(8, np.float32))
+    assert losses[-1] < 1e-2, (kind, losses[-5:])
+    np.testing.assert_allclose(theta, beta, atol=0.1)
+
+
+def test_lbfgs_beats_sgd_on_iterations(session):
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((160, 12)).astype(np.float32)
+    # ill-conditioned quadratic: scale columns
+    x *= np.logspace(0, 2, 12, dtype=np.float32)
+    beta = rng.standard_normal(12).astype(np.float32)
+    y = x @ beta
+    cfg_l = solvers.SolverConfig(lr=1.0, iterations=60)
+    _, loss_l = solvers.Solver(session, "lbfgs", cfg_l).minimize(
+        solvers.mse_objective, x, y, np.zeros(12, np.float32))
+    cfg_s = solvers.SolverConfig(lr=1e-5, iterations=60)
+    _, loss_s = solvers.Solver(session, "sgd", cfg_s).minimize(
+        solvers.mse_objective, x, y, np.zeros(12, np.float32))
+    assert loss_l[-1] < loss_s[-1]

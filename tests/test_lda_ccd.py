@@ -1,0 +1,41 @@
+"""LDA-CGS and CCD++ convergence tests (ml/java lda + ccd parity).
+
+Statistical-parity strategy (SURVEY §7): both are stochastic/coordinate methods —
+assert objective improvement and structure recovery, not bitwise trajectories.
+"""
+
+import numpy as np
+
+from harp_tpu.io import datagen
+from harp_tpu.models import ccd, lda
+
+
+def test_lda_likelihood_improves_and_topics_sharpen(session):
+    docs = datagen.lda_corpus(num_docs=64, vocab=48, num_topics=4, doc_len=24,
+                              seed=5)
+    cfg = lda.LDAConfig(num_topics=4, vocab=48, alpha=0.5, beta=0.1, epochs=15)
+    doc_topic, word_topic, ll = lda.LDA(session, cfg).fit(docs, seed=1)
+
+    assert ll.shape == (cfg.epochs,)
+    assert np.all(np.isfinite(ll))
+    assert ll[-1] > ll[0]          # joint likelihood term improves
+    # counts stay consistent: every token is assigned exactly once
+    assert np.isclose(doc_topic.sum(), docs.size, atol=1e-2)
+    assert np.isclose(word_topic.sum(), docs.size, atol=1e-2)
+    assert doc_topic.min() >= -1e-4 and word_topic.min() >= -1e-4
+    # topics sharpen: mean per-word topic entropy drops vs uniform
+    p = word_topic / np.maximum(word_topic.sum(1, keepdims=True), 1e-9)
+    ent = -(p * np.log(np.maximum(p, 1e-12))).sum(1).mean()
+    assert ent < 0.95 * np.log(cfg.num_topics)
+
+
+def test_ccd_converges(session):
+    rows, cols, vals = datagen.sparse_ratings(80, 64, rank=4, density=0.3,
+                                              seed=13, noise=0.01)
+    cfg = ccd.CCDConfig(rank=8, lam=0.02, outer_iterations=8,
+                        inner_iterations=2)
+    u, v, rmse = ccd.CCD(session, cfg).fit(rows, cols, vals, 80, 64)
+    assert rmse[-1] < 0.12
+    assert rmse[-1] < 0.4 * rmse[0]
+    pred = np.einsum("ij,ij->i", u[rows], v[cols])
+    assert np.sqrt(np.mean((vals - pred) ** 2)) < 0.12
