@@ -16,4 +16,13 @@ Families (reference dirs → modules):
   lda/ (CGS) + contrib/lda (CVB0)   → models.lda
   daal_nn                           → models.nn
   daal_optimization_solvers         → models.solvers
+  contrib/simplepagerank            → models.pagerank
+  wdamds/ (WDA-SMACOF MDS)          → models.mds
+  daal_em (GMM)                     → models.em
+  daal_quality_metrics              → models.quality
+  daal_{stump,adaboost,logitboost,
+        brownboost}                 → models.boosting
+  daal_dtree/daal_dforest + rf      → models.forest
+  daal_ar (association rules)       → models.assoc
+  sahad/ + subgraph/ (color coding) → models.subgraph
 """

@@ -1,0 +1,109 @@
+"""Association rules — Apriori with device-side support counting.
+
+Reference parity: daal_ar (SURVEY §2.7 — DAAL's association-rules batch kernel
+wrapped in a Harp job).
+
+TPU-native split of labor: candidate generation (tiny, combinatorial) runs on
+the host; support counting (the heavy part) runs on the sharded binary
+transaction matrix as one MXU matmul per level — a candidate itemset is a 0/1
+column mask and ``transactions @ maskᵀ == |itemset|`` counts exact containment —
+psum'd across workers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from itertools import combinations
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from harp_tpu.parallel.mesh import WORKERS
+from harp_tpu.session import HarpSession
+
+
+@dataclasses.dataclass(frozen=True)
+class AprioriConfig:
+    min_support: float = 0.1     # fraction of transactions
+    min_confidence: float = 0.6
+    max_size: int = 3
+
+
+def _count_supports(tx, masks, axis_name: str = WORKERS):
+    """tx (N_local, D) 0/1; masks (M, D) 0/1 → psum'd containment counts (M,)."""
+    hits = jax.lax.dot_general(tx, masks, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+    sizes = jnp.sum(masks, axis=1)[None, :]
+    contained = (hits >= sizes - 0.5).astype(jnp.float32)
+    return jax.lax.psum(jnp.sum(contained, axis=0), axis_name)
+
+
+class Apriori:
+    """Distributed Apriori (daal_ar parity)."""
+
+    def __init__(self, session: HarpSession, config: AprioriConfig):
+        self.session = session
+        self.config = config
+        self._fns = {}
+        self.itemsets: Dict[Tuple[int, ...], float] = {}
+        self.rules: List[Tuple[Tuple[int, ...], Tuple[int, ...], float, float]] = []
+
+    def _count(self, tx_dev, cand: List[Tuple[int, ...]], d: int, n: int
+               ) -> np.ndarray:
+        masks = np.zeros((len(cand), d), np.float32)
+        for i, items in enumerate(cand):
+            masks[i, list(items)] = 1.0
+        key = (d,)
+        if key not in self._fns:
+            sess = self.session
+            self._fns[key] = sess.spmd(
+                _count_supports, in_specs=(sess.shard(), sess.replicate()),
+                out_specs=sess.replicate())
+        return np.asarray(self._fns[key](tx_dev, jnp.asarray(masks))) / n
+
+    def fit(self, transactions: np.ndarray) -> "Apriori":
+        """transactions: (N, D) 0/1 matrix. Mines itemsets then rules."""
+        sess, cfg = self.session, self.config
+        n, d = transactions.shape
+        tx_dev = sess.scatter(jnp.asarray(transactions, jnp.float32))
+
+        self.itemsets = {}
+        cand = [(i,) for i in range(d)]
+        for size in range(1, cfg.max_size + 1):
+            if not cand:
+                break
+            support = self._count(tx_dev, cand, d, n)
+            level = {c: float(s) for c, s in zip(cand, support)
+                     if s >= cfg.min_support}
+            self.itemsets.update(level)
+            # candidate generation: join frequent k-sets sharing a (k−1)-prefix
+            freq = sorted(level)
+            cand = []
+            for i, a in enumerate(freq):
+                for b_ in freq[i + 1:]:
+                    if a[:-1] != b_[:-1]:
+                        break
+                    c = a + (b_[-1],)
+                    if all(tuple(sorted(set(c) - {it})) in level for it in c):
+                        cand.append(c)
+        self._mine_rules()
+        return self
+
+    def _mine_rules(self) -> None:
+        cfg = self.config
+        self.rules = []
+        for items, supp in self.itemsets.items():
+            if len(items) < 2:
+                continue
+            for r in range(1, len(items)):
+                for ante in combinations(items, r):
+                    ante_supp = self.itemsets.get(tuple(sorted(ante)))
+                    if not ante_supp:
+                        continue
+                    conf = supp / ante_supp
+                    if conf >= cfg.min_confidence:
+                        cons = tuple(sorted(set(items) - set(ante)))
+                        self.rules.append((tuple(sorted(ante)), cons, supp,
+                                           conf))

@@ -1,0 +1,264 @@
+"""Boosting family — decision stumps + AdaBoost / LogitBoost / BrownBoost.
+
+Reference parity: daal_stump, daal_adaboost, daal_logitboost, daal_brownboost
+(SURVEY §2.7 — DAAL batch boosting kernels wrapped in 1-mapper Harp jobs).
+
+TPU-native: the weak learner is a decision stump trained EXHAUSTIVELY on a
+(feature × threshold × polarity) grid in one shot — the weighted-error tensor is
+a couple of einsums on the MXU, psum'd across workers, and the argmin picks the
+stump. Each boosting round is then one grid evaluation inside a ``lax.scan``;
+the full ensemble trains as a single compiled SPMD program.
+
+Deviation note: BrownBoost's remaining-time line search (solving the
+differential equation for dt each round) is replaced by a fixed time schedule
+dt = c/T with its weighting w_i = exp(−(margin+c−t)²/c) kept exact — the
+reference's DAAL kernel solves for dt numerically; convergence-equivalent on the
+workloads tested, step-equivalent it is not.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from harp_tpu.parallel.mesh import WORKERS
+from harp_tpu.session import HarpSession
+
+
+@dataclasses.dataclass(frozen=True)
+class BoostConfig:
+    rounds: int = 20
+    num_thresholds: int = 16    # per-feature threshold grid size
+    brown_c: float = 4.0        # BrownBoost total time
+
+
+def threshold_grid(x: np.ndarray, num_thresholds: int) -> np.ndarray:
+    """Per-feature quantile thresholds (D, B) computed host-side once."""
+    qs = np.linspace(0.0, 1.0, num_thresholds + 2)[1:-1]
+    return np.quantile(x, qs, axis=0).T.astype(np.float32)   # (D, B)
+
+
+def _stump_errors(below, w_pos, w_neg, axis_name):
+    """Weighted error of every (feature, threshold, polarity) stump.
+
+    below: precomputed (N, D, B) indicator x < thr — loop-invariant, built ONCE
+    outside the boosting scan so XLA never re-materializes it per round.
+    w_pos/w_neg: per-sample weights for y=+1 / y=−1 (zero elsewhere).
+    Returns err (2, D, B): polarity 0 predicts +1 when x<thr.
+    """
+    # polarity 0 (predict +1 below): errors = neg-weight below + pos-weight above
+    neg_below = jnp.einsum("n,ndb->db", w_neg, below)
+    pos_below = jnp.einsum("n,ndb->db", w_pos, below)
+    tot_pos = jnp.sum(w_pos)
+    tot_neg = jnp.sum(w_neg)
+    err0 = neg_below + (tot_pos - pos_below)
+    err1 = pos_below + (tot_neg - neg_below)
+    err = jnp.stack([err0, err1])                             # local
+    return jax.lax.psum(err, axis_name), jax.lax.psum(tot_pos + tot_neg,
+                                                      axis_name)
+
+
+def _best_stump(err):
+    """argmin over the (2, D, B) error tensor → (polarity, feature, bin)."""
+    flat = jnp.argmin(err.reshape(-1))
+    d_b = err.shape[1] * err.shape[2]
+    return flat // d_b, (flat % d_b) // err.shape[2], flat % err.shape[2]
+
+
+def _stump_predict(x, thr, pol, feat, b):
+    below = x[:, feat] < thr[feat, b]
+    sign = jnp.where(below, 1.0, -1.0)
+    return jnp.where(pol == 0, sign, -sign)
+
+
+def _adaboost(x, y_signed, thr, cfg: BoostConfig, axis_name=WORKERS):
+    n_local = x.shape[0]
+    below = (x[:, :, None] < thr[None]).astype(x.dtype)
+
+    def round_(carry, _):
+        w = carry
+        w_pos = jnp.where(y_signed > 0, w, 0.0)
+        w_neg = jnp.where(y_signed < 0, w, 0.0)
+        err, tot = _stump_errors(below, w_pos, w_neg, axis_name)
+        pol, feat, b = _best_stump(err)
+        e = err[pol, feat, b] / tot
+        e = jnp.clip(e, 1e-10, 1.0 - 1e-10)
+        alpha = 0.5 * jnp.log((1.0 - e) / e)
+        h = _stump_predict(x, thr, pol, feat, b)
+        w = w * jnp.exp(-alpha * y_signed * h)
+        w = w / jax.lax.psum(jnp.sum(w), axis_name)
+        return w, (alpha, pol, feat, b)
+
+    w0 = jnp.full((n_local,), 1.0, jnp.float32)
+    w0 = w0 / jax.lax.psum(jnp.sum(w0), axis_name)
+    _, stumps = jax.lax.scan(round_, w0, None, length=cfg.rounds)
+    return stumps
+
+
+def _logitboost(x, y01, thr, cfg: BoostConfig, axis_name=WORKERS):
+    """Binary LogitBoost with regression stumps fit to working responses."""
+    below = (x[:, :, None] < thr[None]).astype(x.dtype)       # (N, D, B)
+
+    def round_(carry, _):
+        f = carry                                   # additive score (N_local,)
+        p = jax.nn.sigmoid(2.0 * f)
+        w = jnp.maximum(p * (1.0 - p), 1e-6)
+        z = jnp.clip((y01 - p) / w, -4.0, 4.0)   # Friedman's z-cap
+        sw_b = jax.lax.psum(jnp.einsum("n,ndb->db", w, below), axis_name)
+        swz_b = jax.lax.psum(jnp.einsum("n,ndb->db", w * z, below), axis_name)
+        sw = jax.lax.psum(jnp.sum(w), axis_name)
+        swz = jax.lax.psum(jnp.sum(w * z), axis_name)
+        left = swz_b / jnp.maximum(sw_b, 1e-10)
+        right = (swz - swz_b) / jnp.maximum(sw - sw_b, 1e-10)
+        # weighted SSE reduction of each (d, b) split
+        gain = (swz_b * left + (swz - swz_b) * right)
+        flat = jnp.argmax(gain.reshape(-1))
+        feat, b = flat // gain.shape[1], flat % gain.shape[1]
+        below_sel = x[:, feat] < thr[feat, b]
+        fm = jnp.where(below_sel, left[feat, b], right[feat, b])
+        f = f + 0.5 * fm
+        return f, (feat, b, left[feat, b], right[feat, b])
+
+    f0 = jnp.zeros((x.shape[0],), jnp.float32)
+    _, stumps = jax.lax.scan(round_, f0, None, length=cfg.rounds)
+    return stumps
+
+
+def _brownboost(x, y_signed, thr, cfg: BoostConfig, axis_name=WORKERS):
+    c = cfg.brown_c
+    dt = c / cfg.rounds
+    below = (x[:, :, None] < thr[None]).astype(x.dtype)
+
+    def round_(carry, i):
+        margin, t = carry
+        w = jnp.exp(-jnp.square(margin + c - t) / c)
+        w_pos = jnp.where(y_signed > 0, w, 0.0)
+        w_neg = jnp.where(y_signed < 0, w, 0.0)
+        err, tot = _stump_errors(below, w_pos, w_neg, axis_name)
+        pol, feat, b = _best_stump(err)
+        e = jnp.clip(err[pol, feat, b] / tot, 1e-10, 1.0 - 1e-10)
+        alpha = 0.5 * jnp.log((1.0 - e) / e) * dt
+        h = _stump_predict(x, thr, pol, feat, b)
+        return (margin + alpha * y_signed * h, t + dt), (alpha, pol, feat, b)
+
+    init = (jnp.zeros((x.shape[0],), jnp.float32), jnp.zeros(()))
+    _, stumps = jax.lax.scan(round_, init, jnp.arange(cfg.rounds))
+    return stumps
+
+
+class _BoostBase:
+    def __init__(self, session: HarpSession, config: BoostConfig = BoostConfig()):
+        self.session = session
+        self.config = config
+        self._fns = {}
+        self.thr = None
+        self.stumps = None
+
+
+class DecisionStump(_BoostBase):
+    """daal_stump: a single optimal weighted stump."""
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> "DecisionStump":
+        sess, cfg = self.session, self.config
+        self.thr = threshold_grid(x, cfg.num_thresholds)
+        y_signed = (2.0 * y - 1.0).astype(np.float32)
+
+        def fn(a, ys, thr):
+            w = jnp.full((a.shape[0],), 1.0, jnp.float32)
+            below = (a[:, :, None] < thr[None]).astype(a.dtype)
+            err, _ = _stump_errors(below, jnp.where(ys > 0, w, 0.0),
+                                   jnp.where(ys < 0, w, 0.0), WORKERS)
+            pol, feat, b = _best_stump(err)
+            return pol, feat, b
+
+        key = (x.shape[1],)
+        if key not in self._fns:
+            self._fns[key] = sess.spmd(
+                fn, in_specs=(sess.shard(), sess.shard(), sess.replicate()),
+                out_specs=(sess.replicate(),) * 3)
+        pol, feat, b = self._fns[key](
+            sess.scatter(jnp.asarray(x, jnp.float32)),
+            sess.scatter(jnp.asarray(y_signed)), jnp.asarray(self.thr))
+        self.stumps = (int(pol), int(feat), int(b))
+        return self
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        pol, feat, b = self.stumps
+        sign = np.where(x[:, feat] < self.thr[feat, b], 1.0, -1.0)
+        pred = sign if pol == 0 else -sign
+        return (pred > 0).astype(np.int32)
+
+
+class AdaBoost(_BoostBase):
+    """daal_adaboost: exhaustive-stump AdaBoost, labels {0, 1}."""
+
+    _train = staticmethod(_adaboost)
+    signed_labels = True
+
+    def fit(self, x: np.ndarray, y: np.ndarray):
+        sess, cfg = self.session, self.config
+        self.thr = threshold_grid(x, cfg.num_thresholds)
+        yy = (2.0 * y - 1.0).astype(np.float32) if self.signed_labels \
+            else y.astype(np.float32)
+        key = (x.shape[1], cfg.rounds)
+        if key not in self._fns:
+            train = type(self)._train
+            self._fns[key] = sess.spmd(
+                lambda a, ys, thr: train(a, ys, thr, cfg),
+                in_specs=(sess.shard(), sess.shard(), sess.replicate()),
+                out_specs=sess.replicate())
+        out = self._fns[key](sess.scatter(jnp.asarray(x, jnp.float32)),
+                             sess.scatter(jnp.asarray(yy)),
+                             jnp.asarray(self.thr))
+        self.stumps = jax.tree.map(np.asarray, out)
+        return self
+
+    def decision_function(self, x: np.ndarray) -> np.ndarray:
+        alpha, pol, feat, b = self.stumps
+        score = np.zeros(x.shape[0], np.float32)
+        for a, p, f, bi in zip(alpha, pol, feat, b):
+            sign = np.where(x[:, f] < self.thr[f, bi], 1.0, -1.0)
+            score += a * (sign if p == 0 else -sign)
+        return score
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        return (self.decision_function(x) > 0).astype(np.int32)
+
+
+class BrownBoost(AdaBoost):
+    """daal_brownboost (fixed time schedule — see module docstring)."""
+
+    _train = staticmethod(_brownboost)
+
+
+class LogitBoost(_BoostBase):
+    """daal_logitboost: binary LogitBoost with regression stumps."""
+
+    def fit(self, x: np.ndarray, y: np.ndarray):
+        sess, cfg = self.session, self.config
+        self.thr = threshold_grid(x, cfg.num_thresholds)
+        key = (x.shape[1], cfg.rounds)
+        if key not in self._fns:
+            self._fns[key] = sess.spmd(
+                lambda a, ys, thr: _logitboost(a, ys, thr, cfg),
+                in_specs=(sess.shard(), sess.shard(), sess.replicate()),
+                out_specs=sess.replicate())
+        out = self._fns[key](sess.scatter(jnp.asarray(x, jnp.float32)),
+                             sess.scatter(jnp.asarray(y, jnp.float32)),
+                             jnp.asarray(self.thr))
+        self.stumps = jax.tree.map(np.asarray, out)
+        return self
+
+    def decision_function(self, x: np.ndarray) -> np.ndarray:
+        feat, b, left, right = self.stumps
+        score = np.zeros(x.shape[0], np.float32)
+        for f, bi, l, r in zip(feat, b, left, right):
+            score += 0.5 * np.where(x[:, f] < self.thr[f, bi], l, r)
+        return score
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        return (self.decision_function(x) > 0).astype(np.int32)

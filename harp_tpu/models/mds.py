@@ -1,0 +1,95 @@
+"""WDA-MDS — weighted multidimensional scaling by SMACOF majorization.
+
+Reference parity: ml/java wdamds (WDAMDSMapper.java:35 — WDA-SMACOF: iterative
+allgather+allreduce matrix ops over BC/stress calc tasks; 2,883 LoC of
+partitioned matrix arithmetic).
+
+TPU-native: the target-distance matrix rows are sharded; each SMACOF iteration
+computes this worker's block of B(X)·X with two MXU matmuls on the replicated
+embedding, an all_gather re-replicates the new embedding, and the stress reduces
+with one psum. The whole iteration loop is one compiled program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from harp_tpu.collectives import lax_ops
+from harp_tpu.ops import distance as dist_ops
+from harp_tpu.parallel.mesh import WORKERS
+from harp_tpu.session import HarpSession
+
+
+@dataclasses.dataclass(frozen=True)
+class MDSConfig:
+    dim: int = 2                # embedding dimensionality (reference: targetDim)
+    iterations: int = 50
+
+
+def _smacof(d_block, w_block, x0, n: int, cfg: MDSConfig,
+            axis_name: str = WORKERS):
+    """d_block/w_block: this worker's rows of the (N, N) target distance and
+    weight matrices. x0: replicated (N, dim) init."""
+    wid = lax_ops.worker_id(axis_name)
+    rows = d_block.shape[0]
+
+    def step(x, _):
+        my_x = jax.lax.dynamic_slice_in_dim(x, wid * rows, rows, 0)
+        cur = jnp.sqrt(jnp.maximum(dist_ops.pairwise_sq_dist(my_x, x), 1e-12))
+        ratio = jnp.where(cur > 1e-9, d_block / cur, 0.0) * w_block
+        # B(X) row block: off-diagonal −ratio, diagonal = row-sum of ratios
+        row_sum = jnp.sum(ratio, axis=1)
+        col_ids = jnp.arange(x.shape[0])[None, :]
+        diag_mask = col_ids == (wid * rows + jnp.arange(rows))[:, None]
+        bx = -ratio + diag_mask * row_sum[:, None]
+        # Guttman transform, uniform-weight V⁺ = I/n (the weighted V⁺ CG solve
+        # of full WDA-SMACOF is a documented simplification; weights still
+        # shape B(X) and the stress)
+        new_block = (bx @ x) / n
+        x_new = lax_ops.allgather(new_block, axis_name)
+        stress = jax.lax.psum(jnp.sum(w_block * (d_block - cur) ** 2),
+                              axis_name)
+        return x_new, stress
+
+    return jax.lax.scan(step, x0, None, length=cfg.iterations)
+
+
+class WDAMDS:
+    """Distributed SMACOF MDS (wdamds parity)."""
+
+    def __init__(self, session: HarpSession, config: MDSConfig):
+        self.session = session
+        self.config = config
+        self._fns = {}
+
+    def fit(self, dist_matrix: np.ndarray, weights: np.ndarray = None,
+            seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+        """Embed N points given an (N, N) target distance matrix.
+
+        Returns (embedding (N, dim), stress per iteration).
+        """
+        sess, cfg = self.session, self.config
+        n = dist_matrix.shape[0]
+        if n % sess.num_workers:
+            raise ValueError(f"N={n} must divide over {sess.num_workers} workers")
+        if weights is None:
+            weights = np.ones_like(dist_matrix)
+        weights = weights * (1.0 - np.eye(n, dtype=weights.dtype))
+        rng = np.random.default_rng(seed)
+        x0 = rng.standard_normal((n, cfg.dim)).astype(np.float32)
+
+        key = (n,)
+        if key not in self._fns:
+            self._fns[key] = sess.spmd(
+                lambda a, b, c: _smacof(a, b, c, n, cfg),
+                in_specs=(sess.shard(), sess.shard(), sess.replicate()),
+                out_specs=(sess.replicate(), sess.replicate()))
+        x, stress = self._fns[key](
+            sess.scatter(jnp.asarray(dist_matrix, jnp.float32)),
+            sess.scatter(jnp.asarray(weights, jnp.float32)), jnp.asarray(x0))
+        return np.asarray(x), np.asarray(stress)

@@ -1,0 +1,101 @@
+"""PageRank — edge-sharded power iteration.
+
+Reference parity: contrib/simplepagerank (HarpPageRank: per-iteration local rank
+contributions pushed over Harp collectives, allreduce-style combine) — one of the
+reference's tutorial algorithms.
+
+TPU-native: each worker owns a block of source vertices and their padded
+out-edge lists; an iteration computes contributions rank[u]/deg[u] scattered to
+destination ids with ``segment_sum`` (LOCAL (V,) table) and one psum replicates
+the combined ranks — the whole power iteration is a ``lax.scan`` in one program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from harp_tpu.parallel.mesh import WORKERS
+from harp_tpu.session import HarpSession
+
+
+@dataclasses.dataclass(frozen=True)
+class PageRankConfig:
+    damping: float = 0.85
+    iterations: int = 30
+
+
+def pad_out_edges(src: np.ndarray, dst: np.ndarray, num_vertices: int,
+                  num_workers: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(edge list) → per-source padded neighbor lists (V_pad, M) + mask + deg."""
+    order = np.argsort(src, kind="stable")
+    s, d = src[order], dst[order]
+    vpw = -(-num_vertices // num_workers)
+    v_pad = vpw * num_workers
+    deg = np.bincount(s, minlength=v_pad)
+    m = max(int(deg.max()), 1)
+    nbr = np.zeros((v_pad, m), np.int32)
+    mask = np.zeros((v_pad, m), np.float32)
+    starts = np.concatenate([[0], np.cumsum(deg)])
+    pos = np.arange(len(s)) - starts[s]
+    nbr[s, pos] = d
+    mask[s, pos] = 1.0
+    return nbr, mask, deg.astype(np.float32)
+
+
+def _pagerank(nbr, mask, deg, num_vertices: int, v_pad: int,
+              cfg: PageRankConfig, axis_name: str = WORKERS):
+    n = jnp.asarray(num_vertices, jnp.float32)
+
+    def step(rank, _):
+        # this worker's block of sources contributes rank/deg to each neighbor
+        wid = jax.lax.axis_index(axis_name)
+        block = deg.shape[0]
+        my_rank = jax.lax.dynamic_slice_in_dim(rank, wid * block, block, 0)
+        contrib = jnp.where(deg > 0, my_rank / jnp.maximum(deg, 1.0), 0.0)
+        scattered = jax.ops.segment_sum(
+            (contrib[:, None] * mask).reshape(-1), nbr.reshape(-1),
+            num_segments=v_pad)
+        total = jax.lax.psum(scattered, axis_name)
+        # dangling mass (deg==0) is redistributed uniformly
+        dangling = jax.lax.psum(jnp.sum(jnp.where(deg == 0, my_rank, 0.0)),
+                                axis_name)
+        new_rank = ((1.0 - cfg.damping) / n
+                    + cfg.damping * (total + dangling / n))
+        # padding vertices hold no rank
+        new_rank = jnp.where(jnp.arange(v_pad) < num_vertices, new_rank, 0.0)
+        delta = jnp.sum(jnp.abs(new_rank - rank))
+        return new_rank, delta
+
+    rank0 = jnp.where(jnp.arange(v_pad) < num_vertices, 1.0 / n, 0.0)
+    return jax.lax.scan(step, rank0, None, length=cfg.iterations)
+
+
+class PageRank:
+    """Distributed PageRank over a HarpSession mesh."""
+
+    def __init__(self, session: HarpSession, config: PageRankConfig):
+        self.session = session
+        self.config = config
+        self._fns = {}
+
+    def run(self, src: np.ndarray, dst: np.ndarray, num_vertices: int
+            ) -> Tuple[np.ndarray, np.ndarray]:
+        """Returns (ranks (num_vertices,), per-iteration L1 delta)."""
+        sess, cfg = self.session, self.config
+        w = sess.num_workers
+        nbr, mask, deg = pad_out_edges(src, dst, num_vertices, w)
+        v_pad = nbr.shape[0]
+        key = (nbr.shape, num_vertices)
+        if key not in self._fns:
+            self._fns[key] = sess.spmd(
+                lambda a, b, c: _pagerank(a, b, c, num_vertices, v_pad, cfg),
+                in_specs=(sess.shard(),) * 3,
+                out_specs=(sess.replicate(), sess.replicate()))
+        ranks, deltas = self._fns[key](
+            sess.scatter(nbr), sess.scatter(mask), sess.scatter(deg))
+        return np.asarray(ranks)[:num_vertices], np.asarray(deltas)

@@ -1,0 +1,175 @@
+"""PageRank / MDS / EM / quality / boosting / trees / apriori / subgraph tests
+(contrib simplepagerank, wdamds, daal_em, daal_quality_metrics, daal_{stump,
+adaboost,logitboost,brownboost}, daal_dtree/dforest, daal_ar, sahad parity)."""
+
+import numpy as np
+import pytest
+
+from harp_tpu.io import datagen
+from harp_tpu.models import (assoc, boosting, em, forest, mds, pagerank,
+                             quality, subgraph)
+
+
+def _ring_edges(n):
+    src = np.arange(n, dtype=np.int64)
+    dst = (src + 1) % n
+    return src, dst
+
+
+def test_pagerank_uniform_on_ring(session):
+    n = 24
+    src, dst = _ring_edges(n)
+    pr = pagerank.PageRank(session, pagerank.PageRankConfig(iterations=30))
+    ranks, deltas = pr.run(src, dst, n)
+    np.testing.assert_allclose(ranks, 1.0 / n, atol=1e-4)
+    assert deltas[-1] < 1e-5
+
+
+def test_pagerank_matches_numpy_power_iteration(session):
+    rng = np.random.default_rng(7)
+    n, m = 40, 200
+    src = rng.integers(0, n, m)
+    dst = rng.integers(0, n, m)
+    cfg = pagerank.PageRankConfig(damping=0.85, iterations=50)
+    ranks, _ = pagerank.PageRank(session, cfg).run(src, dst, n)
+    # numpy reference with same dangling handling
+    deg = np.bincount(src, minlength=n).astype(np.float64)
+    r = np.full(n, 1.0 / n)
+    for _ in range(50):
+        contrib = np.zeros(n)
+        np.add.at(contrib, dst, r[src] / deg[src])
+        dangling = r[deg == 0].sum()
+        r = (1 - 0.85) / n + 0.85 * (contrib + dangling / n)
+    np.testing.assert_allclose(ranks, r, atol=1e-4)
+    np.testing.assert_allclose(ranks.sum(), 1.0, atol=1e-3)
+
+
+def test_mds_recovers_geometry(session):
+    rng = np.random.default_rng(4)
+    pts = rng.standard_normal((48, 2)).astype(np.float32)
+    d = np.sqrt(((pts[:, None] - pts[None]) ** 2).sum(-1)).astype(np.float32)
+    model = mds.WDAMDS(session, mds.MDSConfig(dim=2, iterations=80))
+    x, stress = model.fit(d, seed=1)
+    assert stress[-1] < 0.05 * stress[0]
+    # embedded distances match target distances (up to rigid motion)
+    d_emb = np.sqrt(((x[:, None] - x[None]) ** 2).sum(-1))
+    assert np.abs(d_emb - d).mean() < 0.1 * d.mean()
+
+
+def test_em_gmm_recovers_components(session):
+    rng = np.random.default_rng(9)
+    centers = np.array([[0, 0], [6, 0], [0, 6]], np.float32)
+    x = np.concatenate([
+        c + rng.standard_normal((80, 2)).astype(np.float32) for c in centers])
+    rng.shuffle(x)
+    model = em.EMGMM(session, em.EMConfig(num_components=3, iterations=40))
+    pi, mean, cov, ll = model.fit(x, seed=3)
+    assert ll[-1] > ll[0]
+    np.testing.assert_allclose(sorted(pi), [1 / 3] * 3, atol=0.08)
+    # every true center has a recovered mean nearby
+    for c in centers:
+        assert np.min(np.linalg.norm(mean - c, axis=1)) < 0.6
+
+
+def test_quality_metrics(session):
+    rng = np.random.default_rng(2)
+    y = rng.integers(0, 3, 240).astype(np.int32)
+    pred = y.copy()
+    flip = rng.random(240) < 0.2
+    pred[flip] = (pred[flip] + 1) % 3
+    qm = quality.QualityMetrics(session)
+    out = qm.classification(y, pred, 3)
+    assert abs(out["accuracy"] - (y == pred).mean()) < 1e-5
+    assert out["confusion"].sum() == 240
+    # AUC: separable scores → ~1; random scores → ~0.5
+    yb = rng.integers(0, 2, 240).astype(np.int32)
+    assert qm.auc(yb, yb + 0.1 * rng.random(240).astype(np.float32)) > 0.99
+    reg = qm.regression(np.arange(240, dtype=np.float32),
+                        np.arange(240, dtype=np.float32) + 1.0)
+    assert abs(reg["rmse"] - 1.0) < 1e-4 and reg["r2"] > 0.99
+
+
+@pytest.fixture(scope="module")
+def clf_data():
+    rng = np.random.default_rng(11)
+    n = 320
+    x = rng.standard_normal((n, 6)).astype(np.float32)
+    y = ((x[:, 0] + 0.5 * x[:, 1] - 0.3 * x[:, 2]) > 0).astype(np.int32)
+    return x, y
+
+
+def test_stump_and_adaboost(session, clf_data):
+    x, y = clf_data
+    stump = boosting.DecisionStump(session).fit(x, y)
+    acc_stump = (stump.predict(x) == y).mean()
+    assert acc_stump > 0.65
+    ada = boosting.AdaBoost(session, boosting.BoostConfig(rounds=30)).fit(x, y)
+    acc_ada = (ada.predict(x) == y).mean()
+    assert acc_ada > acc_stump
+    assert acc_ada > 0.85
+
+
+def test_logitboost_and_brownboost(session, clf_data):
+    x, y = clf_data
+    lb = boosting.LogitBoost(session, boosting.BoostConfig(rounds=30)).fit(x, y)
+    assert (lb.predict(x) == y).mean() > 0.85
+    bb = boosting.BrownBoost(session, boosting.BoostConfig(rounds=30)).fit(x, y)
+    assert (bb.predict(x) == y).mean() > 0.8
+
+
+def test_decision_tree_and_forest(session):
+    rng = np.random.default_rng(21)
+    n = 400
+    x = rng.standard_normal((n, 5)).astype(np.float32)
+    # axis-aligned XOR-ish target: tree-friendly, linear-unfriendly
+    y = ((x[:, 0] > 0) ^ (x[:, 1] > 0)).astype(np.int32)
+    tree = forest.DecisionTree(session, forest.TreeConfig(depth=3, num_bins=16,
+                                                          num_classes=2))
+    tree.fit(x, y)
+    assert (tree.predict(x) == y).mean() > 0.9
+    rf = forest.RandomForest(session, forest.TreeConfig(
+        depth=3, num_bins=16, num_classes=2, num_trees=8,
+        feature_fraction=0.8))
+    rf.fit(x, y, seed=1)
+    assert (rf.predict(x) == y).mean() > 0.9
+
+
+def test_apriori(session):
+    rng = np.random.default_rng(5)
+    n, d = 240, 8
+    tx = (rng.random((n, d)) < 0.15).astype(np.float32)
+    # plant a strong pattern: items 0,1 co-occur in 40% of transactions
+    planted = rng.random(n) < 0.4
+    tx[planted, 0] = 1.0
+    tx[planted, 1] = 1.0
+    model = assoc.Apriori(session, assoc.AprioriConfig(
+        min_support=0.2, min_confidence=0.6, max_size=3))
+    model.fit(tx)
+    assert (0,) in model.itemsets and (0, 1) in model.itemsets
+    assert abs(model.itemsets[(0, 1)] - tx[:, [0, 1]].all(1).mean()) < 1e-6
+    assert any(set(a) | set(c) == {0, 1} for a, c, _, _ in model.rules)
+
+
+def test_subgraph_edge_count_exact_expectation(session):
+    # k=2 template: "paths" of 2 vertices = edges; per-trial estimates are
+    # exactly the edge count (every 2-coloring counts each edge with p=1/2,
+    # unbiased correction 1/p = 2) up to coloring noise — mean over trials
+    rng = np.random.default_rng(6)
+    n, m = 32, 80
+    src = rng.integers(0, n, m)
+    dst = (src + 1 + rng.integers(0, n - 1, m)) % n
+    cfg = subgraph.SubgraphConfig(template_size=2, trials=64)
+    est, trials = subgraph.SubgraphCounter(session, cfg).count_paths(
+        src, dst, n, seed=2)
+    assert abs(est - m) < 0.25 * m
+
+
+def test_subgraph_k4_three_paths(session):
+    # K4: number of simple 3-vertex paths = 3 * C(4,3) = 12
+    edges = [(0, 1), (0, 2), (0, 3), (1, 2), (1, 3), (2, 3)]
+    src = np.array([e[0] for e in edges])
+    dst = np.array([e[1] for e in edges])
+    cfg = subgraph.SubgraphConfig(template_size=3, trials=96)
+    est, _ = subgraph.SubgraphCounter(session, cfg).count_paths(src, dst, 4,
+                                                                seed=7)
+    assert abs(est - 12.0) < 6.0
