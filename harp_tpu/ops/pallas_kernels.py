@@ -1,0 +1,104 @@
+"""Pallas TPU kernels — fused hot ops the XLA autofuser can't produce.
+
+Reference parity: the role Intel DAAL's hand-tuned AVX-512 kernels played
+(SURVEY §2.5 — third_party/daal-2018 libJavaAPI.so behind every ml/daal
+algorithm). Here the flagship fused op is the K-means assignment step: distance
+matrix + row argmin + partial-sum accumulation WITHOUT materializing the (N, K)
+distance matrix in HBM — the kernel tiles N, keeps the tile's distances in
+VMEM, and accumulates (K, D) sums / (K,) counts in-place across grid steps.
+
+Falls back transparently to the XLA path (ops/distance.py) on backends without
+pallas TPU lowering; on CPU tests run the kernel in interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from harp_tpu.ops import distance as xla_path
+
+try:
+    from jax.experimental import pallas as pl
+    _HAVE_PALLAS = True
+except Exception:      # pragma: no cover
+    pl = None
+    _HAVE_PALLAS = False
+
+
+def _kmeans_tile_kernel(x_ref, c_ref, sums_ref, counts_ref, cost_ref,
+                        *, block_n: int, k: int):
+    """One N-tile: distances in VMEM, accumulate stats across grid steps."""
+    i = pl.program_id(0)
+    x = x_ref[...]                              # (block_n, D)
+    c = c_ref[...]                              # (K, D)
+    x2 = jnp.sum(x * x, axis=1, keepdims=True)
+    c2 = jnp.sum(c * c, axis=1)[None, :]
+    d = x2 - 2.0 * jax.lax.dot_general(
+        x, c, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) + c2   # (block_n, K) in VMEM
+    assign = jnp.argmin(d, axis=1)
+    min_d = jnp.min(d, axis=1)
+    onehot = jax.nn.one_hot(assign, k, dtype=x.dtype)
+
+    @pl.when(i == 0)
+    def _init():
+        sums_ref[...] = jnp.zeros_like(sums_ref)
+        counts_ref[...] = jnp.zeros_like(counts_ref)
+        cost_ref[...] = jnp.zeros_like(cost_ref)
+
+    sums_ref[...] += jax.lax.dot_general(
+        onehot, x, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    counts_ref[...] += jnp.sum(onehot, axis=0)[None, :]
+    cost_ref[...] += jnp.sum(min_d)[None]
+
+
+def kmeans_stats_pallas(
+    x: jax.Array, c: jax.Array, block_n: int = 1024,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused E-step: returns (sums (K, D), counts (K,), cost scalar).
+
+    Equivalent to ops/distance.partial_sums_counts but never writes the (N, K)
+    distance matrix to HBM. ``x`` rows must be divisible by ``block_n`` (pad
+    with rows equal to centroid 0 and subtract, or pick block_n | N).
+    """
+    n, d = x.shape
+    k = c.shape[0]
+    if n % block_n:
+        raise ValueError(f"N={n} must be divisible by block_n={block_n}")
+    grid = (n // block_n,)
+    kernel = functools.partial(_kmeans_tile_kernel, block_n=block_n, k=k)
+    sums, counts2d, cost1 = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_n, d), lambda i: (i, 0)),
+            pl.BlockSpec((k, d), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((k, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, k), lambda i: (0, 0)),
+            pl.BlockSpec((1,), lambda i: (0,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((k, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, k), jnp.float32),
+            jax.ShapeDtypeStruct((1,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, c)
+    return sums, counts2d[0], cost1[0]
+
+
+def kmeans_stats(x: jax.Array, c: jax.Array, block_n: int = 1024
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Dispatch: pallas on TPU when shapes allow, XLA path otherwise."""
+    on_tpu = jax.default_backend() == "tpu"
+    if _HAVE_PALLAS and on_tpu and x.shape[0] % block_n == 0:
+        return kmeans_stats_pallas(x, c, block_n)
+    return xla_path.partial_sums_counts(x, c)

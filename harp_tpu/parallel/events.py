@@ -1,0 +1,121 @@
+"""Event queue — Harp's asynchronous event API, host-side.
+
+Reference parity: ``Event``/``EventQueue``/``SyncClient`` (client/Event.java,
+io/EventQueue.java:28, client/SyncClient.java:33; CollectiveMapper getEvent:623,
+waitEvent:632, sendEvent:645) with event types LOCAL / MESSAGE / COLLECTIVE.
+
+TPU-native deviation (documented per SURVEY §2.10 "Models A & D"): device-side
+compute is bulk-synchronous under SPMD, so events are a HOST control-plane
+feature. LOCAL events are an in-process queue; MESSAGE/COLLECTIVE events between
+processes ride ``jax.experimental.multihost_utils`` broadcasts at iteration
+boundaries (single-process sessions deliver them locally). Device-side
+point-to-point data movement is ``collectives.lax_ops.send_recv`` (ppermute).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import queue
+import time
+from typing import Any, Optional
+
+
+class EventType(enum.Enum):
+    LOCAL = "local"
+    MESSAGE = "message"          # point-to-point, host control plane
+    COLLECTIVE = "collective"    # delivered to every worker
+
+
+@dataclasses.dataclass
+class Event:
+    type: EventType
+    source: int
+    payload: Any
+    timestamp: float = dataclasses.field(default_factory=time.time)
+
+
+class EventQueue:
+    """Per-process event rendezvous (io/EventQueue.java:28 semantics)."""
+
+    def __init__(self):
+        self._q: "queue.Queue[Event]" = queue.Queue()
+
+    def put(self, event: Event) -> None:
+        self._q.put(event)
+
+    def get(self) -> Optional[Event]:
+        """Non-blocking poll (CollectiveMapper.getEvent:623)."""
+        try:
+            return self._q.get_nowait()
+        except queue.Empty:
+            return None
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[Event]:
+        """Blocking wait (CollectiveMapper.waitEvent:632; Harp's default wait
+        was DATA_MAX_WAIT_TIME=1800 s, Constant.java:36)."""
+        try:
+            return self._q.get(timeout=timeout if timeout is not None else 1800.0)
+        except queue.Empty:
+            return None
+
+    def __len__(self) -> int:
+        return self._q.qsize()
+
+
+class EventClient:
+    """Send side (SyncClient.java:33). In a single-process session events are
+    delivered straight to the local queue; multi-process sessions broadcast
+    through the jax.distributed control plane at the next sync point."""
+
+    def __init__(self, event_queue: EventQueue, worker_id: int = 0):
+        self.queue = event_queue
+        self.worker_id = worker_id
+
+    def send_local(self, payload: Any) -> None:
+        self.queue.put(Event(EventType.LOCAL, self.worker_id, payload))
+
+    def send_collective(self, payload: Any, source: Optional[int] = None
+                        ) -> None:
+        """CollectiveMapper.sendEvent:645 with COLLECTIVE type.
+
+        Multi-process: this is a COLLECTIVE host operation — EVERY process must
+        call it (with the same ``source``, default 0) or the broadcast
+        deadlocks; only the source's payload is delivered. Single-process: the
+        local payload is enqueued directly.
+        """
+        import jax
+
+        src = 0 if source is None else source
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            payload = multihost_utils.broadcast_one_to_all(
+                payload, is_source=jax.process_index() == src)
+        else:
+            src = self.worker_id
+        self.queue.put(Event(EventType.COLLECTIVE, src, payload))
+
+    def send_message(self, dest: int, payload: Any,
+                     source: Optional[int] = None) -> None:
+        """Point-to-point host message, delivered only on ``dest``.
+
+        Multi-process: collective like :meth:`send_collective` (all processes
+        call, one source, non-dest processes drop the payload). Single-process:
+        delivered iff dest is this worker.
+        """
+        import jax
+
+        src = 0 if source is None else source
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            payload = multihost_utils.broadcast_one_to_all(
+                payload, is_source=jax.process_index() == src)
+            if jax.process_index() != dest:
+                return
+        else:
+            src = self.worker_id
+            if dest != self.worker_id:
+                return
+        self.queue.put(Event(EventType.MESSAGE, src, payload))

@@ -1,0 +1,91 @@
+"""Failure detection — watchdog over device liveness.
+
+Reference parity (SURVEY §5): Harp's failure handling is fail-stop — send
+retries (SMALL/LARGE_RETRY_COUNT, Constant.java:50-53), a 1800 s receive
+timeout (DATA_MAX_WAIT_TIME) after which collectives return false and the master
+logs "Slaves may fail" (Communication.java:82), then the job dies. This module
+gives the same fail-stop contract with earlier detection: a heartbeat thread
+runs a trivial device computation on a deadline; a hung/poisoned device trips
+the watchdog instead of blocking for half an hour.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+DEFAULT_TIMEOUT_S = 60.0        # vs the reference's 1800 s
+
+
+class WorkerFailure(RuntimeError):
+    pass
+
+
+def probe_devices(timeout_s: float = DEFAULT_TIMEOUT_S) -> bool:
+    """One liveness probe: a tiny computation must complete within deadline."""
+    done = threading.Event()
+    err: list = []
+
+    def _run():
+        try:
+            jax.device_put(np.ones(())).block_until_ready()
+            done.set()
+        except Exception as e:       # device poisoned
+            err.append(e)
+            done.set()
+
+    t = threading.Thread(target=_run, daemon=True)
+    t.start()
+    if not done.wait(timeout_s):
+        return False
+    return not err
+
+
+class Watchdog:
+    """Background heartbeat (Harp's master barrier 'Slaves may fail' check,
+    made continuous). ``on_failure`` defaults to raising in the main thread via
+    a stored flag checked by :meth:`ok`."""
+
+    def __init__(self, interval_s: float = 10.0,
+                 timeout_s: float = DEFAULT_TIMEOUT_S,
+                 on_failure: Optional[Callable[[], None]] = None):
+        self.interval_s = interval_s
+        self.timeout_s = timeout_s
+        self.on_failure = on_failure
+        self.failed = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Watchdog":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            if not probe_devices(self.timeout_s):
+                self.failed = True
+                if self.on_failure is not None:
+                    self.on_failure()
+                return
+
+    def ok(self) -> None:
+        """Call at iteration boundaries; raises if a heartbeat failed
+        (fail-stop, like the reference's collective-returns-false path)."""
+        if self.failed:
+            raise WorkerFailure("device heartbeat missed deadline")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "Watchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

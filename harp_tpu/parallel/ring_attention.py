@@ -1,0 +1,142 @@
+"""Sequence/context parallelism — ring attention and Ulysses all-to-all.
+
+Reference parity note (SURVEY §5 "long-context"): Harp predates transformers;
+its structural ancestor is model rotation — partition big state around a ring
+and overlap the shift with compute (dymoro). This module makes long-context a
+FIRST-CLASS capability of the TPU framework by instantiating that same rotation
+schedule for attention:
+
+* **Ring attention** (`ring_attention`): queries stay resident; K/V blocks
+  ring-rotate via ``ppermute`` (the exact dymoro/rotate_scan schedule, see
+  collectives/rotation.py) while a numerically-stable streaming softmax
+  (running max + normalizer, flash-attention style) folds in each block. HBM
+  cost per chip is O(L/W · L/W); the full L×L score matrix never exists.
+* **Ulysses SP** (`ulysses_attention`): `all_to_all` re-shards sequence↔heads
+  so each chip runs FULL-sequence attention for its head slice, then shards
+  back. One all_to_all pair per projection, standard DeepSpeed-Ulysses layout.
+
+Both run inside shard_map over the ``workers`` axis and compose with the rest
+of the runtime (same mesh, same collectives).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from harp_tpu.collectives import lax_ops, rotation
+from harp_tpu.parallel.mesh import WORKERS
+
+
+def _block_attn(q, k, v, scale, causal_mask=None):
+    """Scores for one (Q-block, KV-block) pair + streaming-softmax pieces.
+
+    Returns (block max (Nq,), exp-weighted value sum (Nq, Dv), normalizer (Nq,)).
+    """
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal_mask is not None:
+        s = jnp.where(causal_mask, s, -jnp.inf)
+    m = jnp.max(s, axis=1)
+    # guard fully-masked rows (m = -inf): their exp sums stay 0
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe[:, None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    return m_safe, p @ v, jnp.sum(p, axis=1), jnp.isfinite(m)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   causal: bool = False, axis_name: str = WORKERS
+                   ) -> jax.Array:
+    """Exact attention over a sequence sharded along axis 0.
+
+    q/k/v: this worker's sequence block (L/W, D). Returns the attention output
+    block (L/W, Dv). K/V blocks rotate around the ring; the streaming softmax
+    accumulates (flash-attention update rule), so the result is EXACT attention,
+    bit-comparable to the replicated reference up to float associativity.
+    """
+    w = jax.lax.axis_size(axis_name)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    wid = lax_ops.worker_id(axis_name)
+    lq = q.shape[0]
+
+    def body(carry, kv_block, t):
+        m_run, num, den, any_valid = carry
+        kb, vb = kv_block
+        src = (wid - t) % w                   # home worker of resident block
+        if causal:
+            q_pos = wid * lq + jnp.arange(lq)[:, None]
+            k_pos = src * lq + jnp.arange(lq)[None, :]
+            mask = q_pos >= k_pos
+        else:
+            mask = None
+        m_blk, num_blk, den_blk, valid = _block_attn(q, kb, vb, scale, mask)
+        # streaming-softmax merge of (m_run, num, den) with the new block
+        m_new = jnp.where(valid, jnp.maximum(m_run, m_blk), m_run)
+        alpha = jnp.exp(m_run - m_new)            # rescale old accumulators
+        beta = jnp.where(valid, jnp.exp(m_blk - m_new), 0.0)
+        num = num * alpha[:, None] + num_blk * beta[:, None]
+        den = den * alpha + den_blk * beta
+        return (m_new, num, den, any_valid | valid), (kb, vb)
+
+    init = (jnp.full((lq,), -1e30, jnp.float32),
+            jnp.zeros((lq, v.shape[1]), jnp.float32),
+            jnp.zeros((lq,), jnp.float32),
+            jnp.zeros((lq,), bool))
+    (m_run, num, den, _), _ = rotation.rotate_scan(body, init, (k, v), w,
+                                                   axis_name)
+    return num / jnp.maximum(den, 1e-30)[:, None]
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      num_heads: int, causal: bool = False,
+                      axis_name: str = WORKERS) -> jax.Array:
+    """DeepSpeed-Ulysses sequence parallelism.
+
+    q/k/v: (L/W, H, Dh) sequence-sharded with ALL heads. all_to_all re-shards to
+    (L, H/W, Dh) — full sequence, head slice — runs full attention per local
+    head, and all_to_alls back. num_heads must divide the worker count's
+    multiple (H % W == 0).
+    """
+    w = jax.lax.axis_size(axis_name)
+    l_local, h, dh = q.shape
+    if num_heads != h:
+        raise ValueError(f"num_heads={num_heads} != q.shape[1]={h}")
+    if h % w:
+        raise ValueError(f"num_heads {h} must be divisible by {w} workers")
+
+    def seq_to_head(x):
+        # (L/W, H, Dh) → (L, H/W, Dh)
+        xs = x.reshape(l_local, w, h // w, dh).transpose(1, 0, 2, 3)
+        out = jax.lax.all_to_all(xs, axis_name, split_axis=0, concat_axis=0)
+        return out.reshape(w * l_local, h // w, dh)
+
+    def head_to_seq(x):
+        # (L, H/W, Dh) → (L/W, H, Dh)
+        xs = x.reshape(w, l_local, h // w, dh)
+        out = jax.lax.all_to_all(xs, axis_name, split_axis=0, concat_axis=0)
+        return out.transpose(1, 0, 2, 3).reshape(l_local, h, dh)
+
+    qf, kf, vf = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(dh, jnp.float32))
+    s = jnp.einsum("qhd,khd->hqk", qf, kf) * scale
+    if causal:
+        l_full = qf.shape[0]
+        mask = jnp.arange(l_full)[:, None] >= jnp.arange(l_full)[None, :]
+        s = jnp.where(mask[None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("hqk,khd->qhd", p, vf)
+    return head_to_seq(out)
+
+
+def reference_attention(q, k, v, causal: bool = False):
+    """Replicated full attention for parity tests (host/small shapes)."""
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    s = q @ k.T * scale
+    if causal:
+        n = q.shape[0]
+        mask = jnp.arange(n)[:, None] >= jnp.arange(n)[None, :]
+        s = jnp.where(mask, s, -jnp.inf)
+    return jax.nn.softmax(s, axis=-1) @ v

@@ -1,0 +1,90 @@
+"""Metrics & phase timing — the observability layer.
+
+Reference parity (SURVEY §5): Harp logged inline wall-clock per phase with log4j
+(KMeansCollectiveMapper.java:190-195 per-iteration compute/merge/aggregate ms),
+JVM memory via ``logMemUsage``:686 and GC time via ``logGCTime``:696, and pool
+occupancy dumps. No metrics registry existed. Here: a process-local registry of
+counters/gauges/timers with the same phase-timing idiom, plus device-memory
+introspection replacing the JVM calls.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from collections import defaultdict
+from typing import Dict
+
+import jax
+
+log = logging.getLogger("harp_tpu")
+
+
+class Metrics:
+    """Process-local metric registry (counters, gauges, timers)."""
+
+    def __init__(self):
+        self.counters: Dict[str, float] = defaultdict(float)
+        self.gauges: Dict[str, float] = {}
+        self.timers: Dict[str, list] = defaultdict(list)
+
+    def count(self, name: str, value: float = 1.0) -> None:
+        self.counters[name] += value
+
+    def gauge(self, name: str, value: float) -> None:
+        self.gauges[name] = value
+
+    @contextlib.contextmanager
+    def timer(self, name: str):
+        """Phase timer (Harp's per-iteration ms logging idiom)::
+
+            with metrics.timer("iteration"):
+                ...
+        """
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.timers[name].append(time.perf_counter() - t0)
+
+    def timing(self, name: str) -> Dict[str, float]:
+        ts = self.timers.get(name, [])
+        if not ts:
+            return {}
+        return {"count": len(ts), "total_s": sum(ts),
+                "mean_s": sum(ts) / len(ts), "last_s": ts[-1]}
+
+    def snapshot(self) -> Dict[str, object]:
+        return {
+            "counters": dict(self.counters),
+            "gauges": dict(self.gauges),
+            "timers": {k: self.timing(k) for k in self.timers},
+        }
+
+    def log_summary(self) -> None:
+        for name, t in sorted(self.timers.items()):
+            s = self.timing(name)
+            log.info("timer %-24s n=%d total=%.3fs mean=%.4fs",
+                     name, s["count"], s["total_s"], s["mean_s"])
+        for name, v in sorted(self.counters.items()):
+            log.info("counter %-22s %.0f", name, v)
+
+
+DEFAULT = Metrics()
+
+
+def log_device_mem_usage() -> Dict[str, int]:
+    """Device-memory introspection (replaces CollectiveMapper.logMemUsage:686 /
+    logGCTime:696 — there is no GC on the device; HBM stats stand in)."""
+    out = {}
+    for d in jax.devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            continue
+        if stats:
+            out[str(d)] = stats.get("bytes_in_use", 0)
+            log.info("device %s: %d bytes in use", d,
+                     stats.get("bytes_in_use", 0))
+    return out

@@ -1,0 +1,34 @@
+"""Profiler integration — jax.profiler traces replacing Harp's log4j timing.
+
+Reference parity (SURVEY §5): the reference had no dedicated tracer, only inline
+wall-clock logs. The TPU build gets real traces: ``trace(dir)`` captures an XLA
+profile viewable in TensorBoard/xprof, ``annotate(name)`` marks host spans that
+show up on the trace timeline — strictly more capable than the reference, at
+parity cost zero.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a profiler trace for the enclosed block."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named span on the profiler timeline (usable as decorator/context)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def device_memory_profile(path: str) -> None:
+    """Dump a device-memory profile (pprof format)."""
+    jax.profiler.save_device_memory_profile(path)

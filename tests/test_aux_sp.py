@@ -1,0 +1,137 @@
+"""Aux subsystems (checkpoint/metrics/events/failure), collective micro-bench,
+pallas kernel (interpret mode), and sequence parallelism tests."""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from harp_tpu.benchmark import collectives as bench
+from harp_tpu.ops import distance, pallas_kernels
+from harp_tpu.parallel import events, failure, ring_attention
+from harp_tpu.utils import checkpoint, metrics
+
+
+def test_checkpointer_roundtrip(tmp_path):
+    ck = checkpoint.Checkpointer(str(tmp_path), keep=2)
+    state = {"w": np.arange(6.0).reshape(2, 3), "step": np.asarray(3)}
+    for s in (1, 2, 3):
+        ck.save(s, state)
+    assert ck.steps() == [2, 3]            # keep=2 pruned step 1
+    out = ck.restore_latest(like=state)
+    np.testing.assert_allclose(np.asarray(out["w"]), state["w"])
+    assert ck.latest_step() == 3
+
+
+def test_checkpointer_numpy_fallback(tmp_path):
+    ck = checkpoint.Checkpointer(str(tmp_path), use_orbax=False)
+    state = {"a": np.ones(4), "b": np.zeros((2, 2))}
+    ck.save(7, state)
+    out = ck.restore(7, like=state)
+    np.testing.assert_allclose(out["a"], state["a"])
+    assert ck.restore_latest(like=state) is not None
+
+
+def test_metrics_registry():
+    m = metrics.Metrics()
+    m.count("iters", 3)
+    m.gauge("loss", 0.5)
+    with m.timer("phase"):
+        time.sleep(0.01)
+    snap = m.snapshot()
+    assert snap["counters"]["iters"] == 3
+    assert snap["gauges"]["loss"] == 0.5
+    assert snap["timers"]["phase"]["count"] == 1
+    assert snap["timers"]["phase"]["total_s"] >= 0.01
+    m.log_summary()   # must not raise
+
+
+def test_event_queue():
+    q = events.EventQueue()
+    client = events.EventClient(q, worker_id=0)
+    client.send_local({"x": 1})
+    client.send_collective("sync-point")
+    client.send_message(0, "to-self")
+    client.send_message(3, "dropped")     # single-process, not for us
+    got = [q.get(), q.get(), q.get()]
+    assert got[0].type is events.EventType.LOCAL
+    assert got[1].type is events.EventType.COLLECTIVE
+    assert got[2].payload == "to-self"
+    assert q.get() is None
+    assert q.wait(timeout=0.05) is None
+
+
+def test_failure_watchdog():
+    assert failure.probe_devices(timeout_s=30.0)
+    with failure.Watchdog(interval_s=0.05, timeout_s=30.0) as wd:
+        time.sleep(0.15)
+        wd.ok()                            # healthy devices: no raise
+    wd2 = failure.Watchdog()
+    wd2.failed = True
+    with pytest.raises(failure.WorkerFailure):
+        wd2.ok()
+
+
+def test_bench_collectives_smoke(session):
+    results = bench.bench_collectives(session, sizes_kb=[4], loops=3,
+                                      ops=["allreduce", "rotate"])
+    assert len(results) == 2
+    for r in results:
+        assert r.seconds > 0 and r.us_per_op > 0
+    table = bench.format_table(results)
+    assert "allreduce" in table and "GB/s" in table
+
+
+def test_pallas_kmeans_kernel_interpret_matches_xla():
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((256, 16)), jnp.float32)
+    c = jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)
+    sums_ref, counts_ref, cost_ref = distance.partial_sums_counts(x, c)
+    sums, counts, cost = pallas_kernels.kmeans_stats_pallas(
+        x, c, block_n=64, interpret=True)
+    np.testing.assert_allclose(np.asarray(sums), np.asarray(sums_ref),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(counts), np.asarray(counts_ref),
+                               rtol=1e-6)
+    np.testing.assert_allclose(float(cost), float(cost_ref), rtol=1e-4)
+
+
+def test_ring_attention_matches_reference(session):
+    rng = np.random.default_rng(5)
+    l, d, dv = 64, 16, 16
+    q = rng.standard_normal((l, d)).astype(np.float32)
+    k = rng.standard_normal((l, d)).astype(np.float32)
+    v = rng.standard_normal((l, dv)).astype(np.float32)
+
+    for causal in (False, True):
+        out = session.run(
+            lambda a, b, c: ring_attention.ring_attention(a, b, c, causal),
+            session.scatter(jnp.asarray(q)), session.scatter(jnp.asarray(k)),
+            session.scatter(jnp.asarray(v)),
+            in_specs=(session.shard(),) * 3, out_specs=session.shard())
+        ref = ring_attention.reference_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_ulysses_attention_matches_reference(session):
+    rng = np.random.default_rng(9)
+    l, h, dh = 64, 8, 8
+    q = rng.standard_normal((l, h, dh)).astype(np.float32)
+    k = rng.standard_normal((l, h, dh)).astype(np.float32)
+    v = rng.standard_normal((l, h, dh)).astype(np.float32)
+    out = session.run(
+        lambda a, b, c: ring_attention.ulysses_attention(a, b, c, h, True),
+        session.scatter(jnp.asarray(q)), session.scatter(jnp.asarray(k)),
+        session.scatter(jnp.asarray(v)),
+        in_specs=(session.shard(),) * 3, out_specs=session.shard())
+    # per-head reference
+    ref = np.stack([
+        np.asarray(ring_attention.reference_attention(
+            jnp.asarray(q[:, i]), jnp.asarray(k[:, i]), jnp.asarray(v[:, i]),
+            True)) for i in range(h)], axis=1)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-3, atol=2e-3)
