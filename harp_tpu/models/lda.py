@@ -47,6 +47,7 @@ class LDAConfig:
     alpha: float = 0.1
     beta: float = 0.01
     epochs: int = 20
+    method: str = "cgs"         # "cgs" (ml/java lda) or "cvb0" (contrib/lda)
 
 
 def bucketize_tokens(docs: np.ndarray, num_blocks: int, vpb: int
@@ -82,6 +83,9 @@ class LDA:
     """Distributed CGS-LDA over a HarpSession mesh."""
 
     def __init__(self, session: HarpSession, config: LDAConfig):
+        if config.method not in ("cgs", "cvb0"):
+            raise ValueError(f"method must be 'cgs' or 'cvb0', got "
+                             f"{config.method!r}")
         self.session = session
         self.config = config
         self._fns = {}
@@ -102,13 +106,17 @@ class LDA:
                 src = (wid - t) % w           # home block of resident slice
                 docs_s = jnp.take(docs_b, src, axis=1)        # (D, Lb)
                 mask_s = jnp.take(mask_b, src, axis=1)
-                z_s = jnp.take(z, src, axis=1)
                 w_local = docs_s - src * vpb
 
-                # blocked Gibbs: resident-block tokens sample from current
+                # blocked update: resident-block tokens update from current
                 # counts: p(z=k) ∝ (n_dk−cur+α)(n_wk−cur+β)/(n_k−cur+Vβ)
-                cur = (jax.nn.one_hot(z_s, k, dtype=jnp.float32)
-                       * mask_s[..., None])                   # (D, Lb, K)
+                if cfg.method == "cvb0":
+                    # z carries SOFT assignments gamma (D, W, Lb, K)
+                    cur = jnp.take(z, src, axis=1) * mask_s[..., None]
+                else:
+                    z_s = jnp.take(z, src, axis=1)
+                    cur = (jax.nn.one_hot(z_s, k, dtype=jnp.float32)
+                           * mask_s[..., None])               # (D, Lb, K)
                 nd = doc_topic[:, None, :] - cur              # exclude self
                 nw = wt_block[w_local] - cur
                 nk = topic_tot[None, None, :] - cur
@@ -116,12 +124,21 @@ class LDA:
                           + jnp.log(jnp.maximum(nw + cfg.beta, 1e-10))
                           - jnp.log(jnp.maximum(nk + cfg.vocab * cfg.beta,
                                                 1e-10)))
-                key, sub = jax.random.split(key)
-                z_new = jax.random.categorical(sub, logits, axis=-1)
-
-                # apply count deltas (one-hot matmuls on the MXU)
-                new = (jax.nn.one_hot(z_new, k, dtype=jnp.float32)
-                       * mask_s[..., None])
+                if cfg.method == "cvb0":
+                    # CVB0 (contrib/lda CVB0 LdaMapCollective): deterministic
+                    # mean-field update — soft assignment = normalized
+                    # probabilities instead of a sample
+                    new = jax.nn.softmax(logits, axis=-1) * mask_s[..., None]
+                    z = jnp.where(
+                        (jnp.arange(w) == src)[None, :, None, None],
+                        new[:, None, :, :], z)
+                else:
+                    key, sub = jax.random.split(key)
+                    z_new = jax.random.categorical(sub, logits, axis=-1)
+                    new = (jax.nn.one_hot(z_new, k, dtype=jnp.float32)
+                           * mask_s[..., None])
+                    z = jnp.where((jnp.arange(w) == src)[None, :, None],
+                                  z_new[:, None, :], z)
                 delta = new - cur                             # (D, Lb, K)
                 doc_topic = doc_topic + delta.sum(axis=1)
                 wt_block = wt_block + jax.ops.segment_sum(
@@ -129,14 +146,15 @@ class LDA:
                 # bounded-staleness topic totals: refresh by psum of deltas
                 topic_tot = topic_tot + jax.lax.psum(delta.sum(axis=(0, 1)),
                                                      lax_ops.WORKERS)
-                z = jnp.where((jnp.arange(w) == src)[None, :, None],
-                              z_new[:, None, :], z)
                 return (doc_topic, z, topic_tot, key), wt_block
 
             key = jax.random.fold_in(jax.random.PRNGKey(0),
                                      seed + lax_ops.worker_id())
-            doc_topic = (jax.nn.one_hot(z0, k, dtype=jnp.float32)
-                         * mask_b[..., None]).sum(axis=(1, 2))
+            if cfg.method == "cvb0":
+                doc_topic = (z0 * mask_b[..., None]).sum(axis=(1, 2))
+            else:
+                doc_topic = (jax.nn.one_hot(z0, k, dtype=jnp.float32)
+                             * mask_b[..., None]).sum(axis=(1, 2))
             topic_tot = jax.lax.psum(doc_topic.sum(axis=0), lax_ops.WORKERS)
 
             def epoch(state, _):
@@ -186,8 +204,12 @@ class LDA:
         np.add.at(wt, docs_b.reshape(-1),
                   np.eye(cfg.num_topics, dtype=np.float32)[z0.reshape(-1)]
                   * mask_b.reshape(-1, 1))
+        if cfg.method == "cvb0":
+            # soft assignments: one-hot init (same counts as the CGS init)
+            z0 = (np.eye(cfg.num_topics, dtype=np.float32)[z0]
+                  * mask_b[..., None])
 
-        key = (w, v_pad, lb, num_docs)
+        key = (w, v_pad, lb, num_docs, cfg.method)
         if key not in self._fns:
             self._fns[key] = self._build(w, v_pad, lb)
         doc_topic, wt_out, z, ll = self._fns[key](

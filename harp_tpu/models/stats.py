@@ -102,6 +102,17 @@ class QR(_SPMDWrapper):
         return np.asarray(q), np.asarray(r)
 
 
+class PivotedQR(_SPMDWrapper):
+    """daal_pivoted_qr: column-pivoted distributed QR.
+    Returns (Q (N, D), R (D, D), pivots) with x[:, pivots] == Q @ R."""
+
+    def compute(self, x: np.ndarray):
+        fn = self._compile("pqr", lambda a: linalg.pivoted_qr(a), 2,
+                           extra_sharded_out=1)
+        q, r, piv = fn(self.session.scatter(jnp.asarray(x)))
+        return np.asarray(q), np.asarray(r), np.asarray(piv)
+
+
 class SVD(_SPMDWrapper):
     """daal_svd: distributed SVD of a tall matrix. Returns (U (N, D), s, V^T)."""
 

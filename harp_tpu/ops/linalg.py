@@ -140,6 +140,36 @@ def tsqr(x: jax.Array, axis_name: str = WORKERS) -> Tuple[jax.Array, jax.Array]:
     return (q1 @ my_q2) * sign[None, :], r * sign[:, None]
 
 
+def pivoted_qr(x: jax.Array, axis_name: str = WORKERS
+               ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Column-pivoted tall-skinny QR (daal_pivoted_qr).
+
+    Pivots come from a pivoted Cholesky of the psum'd gram matrix (the pivot
+    order of QR-with-column-pivoting equals the pivot order of Cholesky on
+    X'X); the factorization itself is then a plain TSQR of the permuted
+    columns. Returns (local Q block, R (D, D), pivot permutation (D,) such
+    that x[:, pivots] == Q @ R).
+    """
+    gram = psum_gram(x, x, axis_name)
+    d = gram.shape[0]
+
+    def body(carry, _):
+        g, perm, done = carry
+        # greedy: next pivot = largest remaining diagonal
+        diag = jnp.where(done, -jnp.inf, jnp.diag(g))
+        j = jnp.argmax(diag)
+        piv = jnp.maximum(diag[j], 1e-30)
+        col = g[:, j] / piv
+        g = g - piv * jnp.outer(col, col)       # Schur complement update
+        return (g, perm.at[jnp.sum(done)].set(j), done.at[j].set(True)), None
+
+    init = (gram, jnp.zeros((d,), jnp.int32), jnp.zeros((d,), bool))
+    (g, perm, _), _ = jax.lax.scan(body, init, None, length=d)
+    xp = jnp.take(x, perm, axis=1)
+    q, r = tsqr(xp, axis_name)
+    return q, r, perm
+
+
 def svd_tall(x: jax.Array, axis_name: str = WORKERS
              ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Distributed SVD of tall x via TSQR + small SVD of R (daal_svd).

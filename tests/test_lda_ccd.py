@@ -39,3 +39,31 @@ def test_ccd_converges(session):
     assert rmse[-1] < 0.4 * rmse[0]
     pred = np.einsum("ij,ij->i", u[rows], v[cols])
     assert np.sqrt(np.mean((vals - pred) ** 2)) < 0.12
+
+
+def test_lda_cvb0_deterministic_and_improves(session):
+    docs = datagen.lda_corpus(num_docs=48, vocab=40, num_topics=3, doc_len=20,
+                              seed=8)
+    cfg = lda.LDAConfig(num_topics=3, vocab=40, alpha=0.5, beta=0.1, epochs=10,
+                        method="cvb0")
+    model = lda.LDA(session, cfg)
+    dt1, wt1, ll1 = model.fit(docs, seed=2)
+    dt2, wt2, ll2 = model.fit(docs, seed=2)
+    np.testing.assert_allclose(ll1, ll2)        # CVB0 is deterministic
+    assert ll1[-1] > ll1[0]
+    assert np.isclose(dt1.sum(), docs.size, atol=1e-1)
+    assert np.isclose(wt1.sum(), docs.size, atol=1e-1)
+
+
+def test_pivoted_qr(session):
+    from harp_tpu.models import stats
+    rng = np.random.default_rng(3)
+    # rank-deficient-ish: last column nearly dependent
+    x = rng.standard_normal((64, 6)).astype(np.float32)
+    x[:, 5] = x[:, 0] * 2.0 + 1e-3 * rng.standard_normal(64)
+    q, r, piv = stats.PivotedQR(session).compute(x)
+    np.testing.assert_allclose(q @ r, x[:, piv], rtol=1e-3, atol=1e-3)
+    assert sorted(piv.tolist()) == list(range(6))
+    # pivoting pushes the near-dependent direction last: |R| diag decreasing-ish
+    d = np.abs(np.diag(r))
+    assert d[0] >= d[-1]
