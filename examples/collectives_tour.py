@@ -1,0 +1,93 @@
+"""API examples — one tiny program per collective.
+
+Reference parity: ml/java examples/ (ExamplesMain.java, AllReduce.java,
+Rotate.java, ... — one minimal mapper per collective op). Run with:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/collectives_tour.py
+"""
+
+import os
+import sys
+
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax                                             # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp                                # noqa: E402
+import numpy as np                                     # noqa: E402
+
+from harp_tpu import MAX, HarpSession, Table           # noqa: E402
+from harp_tpu.collectives import lax_ops, table_ops    # noqa: E402
+
+
+def main():
+    sess = HarpSession()
+    w = sess.num_workers
+    print(f"mesh: {w} workers on {jax.default_backend()}")
+
+    # Each example mirrors one reference examples/ mapper: build a LOCAL table
+    # of per-worker contributions, run ONE collective, print the result.
+    contrib = np.arange(w * 4, dtype=np.float32).reshape(w, 4)
+
+    def allreduce_ex(x):
+        t = Table.local(x, num_workers=w)
+        return table_ops.allreduce(t).trim()
+
+    def regroup_allgather_ex(x):
+        t = Table.local(x, num_workers=w)
+        g = table_ops.regroup(t)                    # each worker owns a block
+        return table_ops.allgather(g).trim()        # …and shares it back
+
+    def rotate_ex(x):
+        t = Table.sharded(x, num_workers=w)
+        return table_ops.rotate(t, steps=1).data
+
+    def broadcast_ex(x):
+        t = Table.local(x, num_workers=w)
+        return table_ops.broadcast(t, root=0).trim()
+
+    def reduce_max_ex(x):
+        t = Table.local(x, num_workers=w, combiner=MAX)
+        return table_ops.allreduce(t).trim()
+
+    def push_pull_ex(x):
+        local = Table.local(x, num_workers=w)
+        zero = Table.sharded(jnp.zeros((x.shape[0] // w,) + x.shape[1:]),
+                             num_workers=w)
+        g = table_ops.push(local, zero)
+        return table_ops.pull(g).trim()
+
+    rep = sess.replicate()
+    for name, fn, spec in [
+        ("allreduce", allreduce_ex, rep),
+        ("regroup+allgather", regroup_allgather_ex, rep),
+        ("broadcast", broadcast_ex, rep),
+        ("allreduce(MAX)", reduce_max_ex, rep),
+        ("push/pull", push_pull_ex, rep),
+    ]:
+        out = sess.run(fn, sess.replicate_put(jnp.asarray(contrib)),
+                       in_specs=(rep,), out_specs=spec)
+        print(f"{name:>18}: row0 = {np.asarray(out)[0]}")
+
+    # rotate works on the sharded view: worker i's block moves to worker i+1
+    blocks = np.arange(w * 2, dtype=np.float32).reshape(w * 2, 1)
+    out = sess.run(rotate_ex, sess.scatter(jnp.asarray(blocks)),
+                   in_specs=(sess.shard(),), out_specs=sess.shard())
+    print(f"{'rotate':>18}: {np.asarray(out).ravel()}")
+
+    # barrier + worker identity (Workers.getSelfID equivalent)
+    ids = sess.run(lambda x: x * 0 + lax_ops.worker_id(),
+                   sess.scatter(jnp.zeros((w, 1))),
+                   in_specs=(sess.shard(),), out_specs=sess.shard())
+    print(f"{'worker ids':>18}: {np.asarray(ids).ravel()}")
+
+
+if __name__ == "__main__":
+    main()

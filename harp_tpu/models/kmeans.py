@@ -23,7 +23,9 @@ MXU matmuls, ops/distance.py), then the chosen collective combines them:
 
 All variants produce bit-identical centroid trajectories (they compute the same
 sums in the same tree order per partition), which the tests assert — the reference
-could only claim statistical equivalence across its variants.
+could only claim statistical equivalence across its variants. The bit-identity
+guarantee holds for the default f32 path; ``compute_dtype="bfloat16"`` keeps all
+accumulations f32 but near-tie assignments may differ across variants.
 """
 
 from __future__ import annotations
@@ -54,6 +56,7 @@ class KMeansConfig:
     dim: int = 100
     iterations: int = 10
     comm: str = "regroupallgather"
+    compute_dtype: str = "float32"   # "bfloat16": bf16 matmuls, f32 accumulate
 
 
 class KMeans:
@@ -71,20 +74,24 @@ class KMeans:
         w = sess.num_workers
         k_pad = Table.local(jnp.zeros((cfg.num_centroids, 1)), num_workers=w).num_partitions
 
-        def estep(points, centroids):
-            sums, counts, sq = distance.partial_sums_counts(points, centroids)
+        cdtype = None if cfg.compute_dtype == "float32" else jnp.dtype(
+            cfg.compute_dtype)
+
+        def estep(points, centroids, x_sq_sum=None):
+            sums, counts, sq = distance.partial_sums_counts(points, centroids,
+                                                            cdtype, x_sq_sum)
             stats = jnp.concatenate([sums, counts[:, None]], axis=1)  # (K, D+1)
             return stats, sq
 
         def average(stats):
             return stats[:, :-1] / jnp.maximum(stats[:, -1:], 1.0)
 
-        def iter_body(centroids, points):
+        def iter_body(centroids, points, x_sq_sum=None):
             if cfg.comm == "rotation":
                 new_c, sq = self._rotation_iter(points, centroids, k_pad, w)
                 cost = jax.lax.psum(sq, lax_ops.WORKERS)
                 return new_c, cost
-            stats, sq = estep(points, centroids)
+            stats, sq = estep(points, centroids, x_sq_sum)
             local = Table.local(stats, num_workers=w, name="cen")
             if cfg.comm == "regroupallgather":
                 # KMeansCollectiveMapper :168-189: regroup → average own block → allgather
@@ -111,10 +118,14 @@ class KMeans:
         def fit_fn(points, centroids0):
             pad = k_pad - cfg.num_centroids
             cen = jnp.pad(centroids0, ((0, pad), (0, 0))) if pad else centroids0
+            # Σ‖x‖² is iteration-invariant: hoist it so the hot loop reads the
+            # point block exactly twice per iteration (the two MXU matmuls)
+            pf = points.astype(jnp.float32)
+            x_sq_sum = jnp.sum(pf * pf)
 
             def scan_body(c, _):
                 c_trim = c[: cfg.num_centroids]
-                new_c, cost = iter_body(c_trim, points)
+                new_c, cost = iter_body(c_trim, points, x_sq_sum)
                 newc_pad = jnp.pad(new_c, ((0, pad), (0, 0))) if pad else new_c
                 return newc_pad, cost
 
@@ -158,7 +169,9 @@ class KMeans:
         onehot = jax.nn.one_hot(best_id, k_pad, dtype=points.dtype)
         sums = jax.lax.dot_general(onehot, points, (((0,), (0,)), ((), ())),
                                    preferred_element_type=jnp.float32)
-        counts = jnp.sum(onehot, axis=0)
+        # counts must accumulate in f32: a bf16 one-hot (bf16 point storage)
+        # cannot represent integer sums past 256
+        counts = jnp.sum(onehot.astype(jnp.float32), axis=0)
         stats = jnp.concatenate([sums, counts[:, None]], axis=1)
         full = table_ops.allreduce(Table.local(stats, num_workers=w))
         new_c = full.data[: cfg.num_centroids, :-1] / jnp.maximum(
@@ -178,14 +191,21 @@ class KMeans:
 
     def prepare(self, points, centroids0):
         """Place data on the mesh once; pair with :meth:`fit_prepared` to keep
-        host→device transfer out of timed regions."""
+        host→device transfer out of timed regions.
+
+        With ``compute_dtype="bfloat16"`` the point block is STORED in bf16 —
+        the E-step is HBM-bound on reading the points (twice per iteration), so
+        halving the bytes is the dominant lever on v5e; norms and all
+        accumulations stay f32."""
         n = points.shape[0]
         if n % self.session.num_workers:
             raise ValueError(
                 f"num points {n} must divide over {self.session.num_workers} workers"
                 " (pad at ingest)")
-        pts = self.session.scatter(jnp.asarray(points))
-        cen = self.session.replicate_put(jnp.asarray(centroids0))
+        dtype = (jnp.bfloat16 if self.config.compute_dtype == "bfloat16"
+                 else jnp.float32)
+        pts = self.session.scatter(jnp.asarray(points, dtype))
+        cen = self.session.replicate_put(jnp.asarray(centroids0, jnp.float32))
         return pts, cen
 
     def fit_prepared(self, pts: jax.Array, cen: jax.Array):

@@ -25,13 +25,23 @@ import jax
 import jax.numpy as jnp
 
 
-def pairwise_sq_dist(x: jax.Array, c: jax.Array) -> jax.Array:
-    """Squared Euclidean distances (N, K) between rows of x (N, D) and c (K, D)."""
-    x2 = jnp.sum(x * x, axis=1, keepdims=True)            # (N, 1)
-    c2 = jnp.sum(c * c, axis=1)[None, :]                  # (1, K)
-    # bf16 matmul with f32 accumulation: MXU-native precision recipe.
+def pairwise_sq_dist(x: jax.Array, c: jax.Array,
+                     compute_dtype=None) -> jax.Array:
+    """Squared Euclidean distances (N, K) between rows of x (N, D) and c (K, D).
+
+    ``compute_dtype=jnp.bfloat16`` runs the cross-term matmul in bf16 with f32
+    accumulation — the MXU-native recipe; the squared-norm terms stay f32 so
+    only the (well-conditioned) cross term loses mantissa. On v5e this halves
+    the dominant (N, K) HBM traffic.
+    """
+    xf = x.astype(jnp.float32)
+    cf = c.astype(jnp.float32)
+    x2 = jnp.sum(xf * xf, axis=1, keepdims=True)          # (N, 1), f32 norms
+    c2 = jnp.sum(cf * cf, axis=1)[None, :]                # (1, K)
+    xm = x if compute_dtype is None else x.astype(compute_dtype)
+    cm = c if compute_dtype is None else c.astype(compute_dtype)
     xc = jax.lax.dot_general(
-        x, c, (((1,), (1,)), ((), ())),
+        xm, cm, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)               # (N, K)
     return x2 - 2.0 * xc + c2
 
@@ -42,19 +52,40 @@ def assign_clusters(x: jax.Array, c: jax.Array) -> jax.Array:
 
 
 def partial_sums_counts(
-    x: jax.Array, c: jax.Array
+    x: jax.Array, c: jax.Array, compute_dtype=None, x_sq_sum=None
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One K-means E-step on this worker's block.
 
     Returns (sums (K, D), counts (K,), sq_dist_sum scalar) — the LOCAL table payload
     that Harp's CenCalcTask + CenMergeTask produced per worker.
+
+    ``compute_dtype=jnp.bfloat16``: both MXU matmuls and the (N, K) one-hot run
+    in bf16 with f32 accumulation; the accumulated sums/counts stay f32, so the
+    M-step averages keep full precision (assignment flips only where two
+    centroids are within bf16 epsilon — empirically nil on clustered data).
+
+    ``x_sq_sum``: precomputed Σ‖x‖² (scalar). Pass it when calling in a loop —
+    it is iteration-invariant and hoisting it removes a full read of x.
     """
-    d = pairwise_sq_dist(x, c)
-    assign = jnp.argmin(d, axis=1)
-    min_d = jnp.min(d, axis=1)
-    onehot = jax.nn.one_hot(assign, c.shape[0], dtype=x.dtype)  # (N, K)
+    # argmin over ‖x−c‖² == argmin over (‖c‖² − 2x·c): the per-row ‖x‖² term is
+    # constant and never needs materializing — the E-step reads x exactly
+    # twice (two MXU matmuls) and touches no (N, D)-sized temporaries.
+    cf = c.astype(jnp.float32)
+    c2 = jnp.sum(cf * cf, axis=1)[None, :]                # (1, K)
+    xm = x if compute_dtype is None else x.astype(compute_dtype)
+    cm = c if compute_dtype is None else c.astype(compute_dtype)
+    xc = jax.lax.dot_general(xm, cm, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    scores = c2 - 2.0 * xc                                # (N, K)
+    assign = jnp.argmin(scores, axis=1)
+    min_s = jnp.min(scores, axis=1)
+    oh_dtype = x.dtype if compute_dtype is None else compute_dtype
+    onehot = jax.nn.one_hot(assign, c.shape[0], dtype=oh_dtype)  # (N, K)
     sums = jax.lax.dot_general(                                  # (K, D) on MXU
-        onehot, x, (((0,), (0,)), ((), ())),
+        onehot, xm, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
-    counts = jnp.sum(onehot, axis=0)
-    return sums, counts, jnp.sum(min_d)
+    counts = jnp.sum(onehot.astype(jnp.float32), axis=0)
+    if x_sq_sum is None:
+        xf = x.astype(jnp.float32)
+        x_sq_sum = jnp.sum(xf * xf)
+    return sums, counts, jnp.sum(min_s) + x_sq_sum

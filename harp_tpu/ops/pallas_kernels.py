@@ -31,18 +31,28 @@ except Exception:      # pragma: no cover
 
 def _kmeans_tile_kernel(x_ref, c_ref, sums_ref, counts_ref, cost_ref,
                         *, block_n: int, k: int):
-    """One N-tile: distances in VMEM, accumulate stats across grid steps."""
+    """One N-tile: distances in VMEM, stats accumulated across grid steps.
+
+    Mosaic constraints honed on real hardware: (1) the argmin/one-hot lowering
+    allocates a (block_n, K, 128lane) scoped temporary — block_n must stay
+    ≤ ~256 to fit the 16 MB scoped-vmem budget; (2) computing jnp.min AND
+    jnp.argmin of the same tensor crashes the compiler — the min comes from
+    the one-hot instead; (3) scalar accumulators need a lane-width (1, 128)
+    block."""
     i = pl.program_id(0)
     x = x_ref[...]                              # (block_n, D)
     c = c_ref[...]                              # (K, D)
-    x2 = jnp.sum(x * x, axis=1, keepdims=True)
+    # score = ‖c‖² − 2x·c (row-constant ‖x‖² dropped from the argmin; its sum
+    # is added back to the cost as a scalar). Avoids (block_n, 1) temporaries,
+    # which mosaic lowers poorly.
     c2 = jnp.sum(c * c, axis=1)[None, :]
-    d = x2 - 2.0 * jax.lax.dot_general(
+    s = c2 - 2.0 * jax.lax.dot_general(
         x, c, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) + c2   # (block_n, K) in VMEM
-    assign = jnp.argmin(d, axis=1)
-    min_d = jnp.min(d, axis=1)
+        preferred_element_type=jnp.float32)        # (block_n, K) in VMEM
+    assign = jnp.argmin(s, axis=1)
     onehot = jax.nn.one_hot(assign, k, dtype=x.dtype)
+    min_sum = jnp.sum(onehot * s)
+    x_sq = jnp.sum(x * x)
 
     @pl.when(i == 0)
     def _init():
@@ -54,11 +64,11 @@ def _kmeans_tile_kernel(x_ref, c_ref, sums_ref, counts_ref, cost_ref,
         onehot, x, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
     counts_ref[...] += jnp.sum(onehot, axis=0)[None, :]
-    cost_ref[...] += jnp.sum(min_d)[None]
+    cost_ref[...] += jnp.full((1, 128), min_sum + x_sq, jnp.float32)
 
 
 def kmeans_stats_pallas(
-    x: jax.Array, c: jax.Array, block_n: int = 1024,
+    x: jax.Array, c: jax.Array, block_n: int = 256,
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Fused E-step: returns (sums (K, D), counts (K,), cost scalar).
@@ -71,11 +81,31 @@ def kmeans_stats_pallas(
     k = c.shape[0]
     if n % block_n:
         raise ValueError(f"N={n} must be divisible by block_n={block_n}")
-    grid = (n // block_n,)
+    if block_n % 8:
+        raise ValueError(f"block_n={block_n} must be divisible by 8 (sublanes)")
+    if block_n > 256 and not interpret:
+        raise ValueError(
+            f"block_n={block_n} exceeds 256: the mosaic argmin lowering "
+            "allocates a (block_n, K, 128)-lane scoped temporary and blows the "
+            "16 MB scoped-vmem budget (opaque compiler crash) — use <= 256")
+    # mosaic blocks need (8, 128)-aligned trailing dims: pad features with
+    # zeros (distances/sums unchanged) and centroid ROWS with a huge constant
+    # so no point ever assigns to a padding centroid
+    d_pad = -(-d // 128) * 128
+    k_pad = -(-k // 8) * 8
+    k_orig, d_orig = k, d
+    if d_pad != d:
+        x = jnp.pad(x, ((0, 0), (0, d_pad - d)))
+        c = jnp.pad(c, ((0, 0), (0, d_pad - d)))
+    if k_pad != k:
+        c = jnp.concatenate(
+            [c, jnp.full((k_pad - k, d_pad), 1e6, c.dtype)], axis=0)
+    k, d = k_pad, d_pad
+    g = n // block_n
     kernel = functools.partial(_kmeans_tile_kernel, block_n=block_n, k=k)
     sums, counts2d, cost1 = pl.pallas_call(
         kernel,
-        grid=grid,
+        grid=(g,),
         in_specs=[
             pl.BlockSpec((block_n, d), lambda i: (i, 0)),
             pl.BlockSpec((k, d), lambda i: (0, 0)),
@@ -83,22 +113,31 @@ def kmeans_stats_pallas(
         out_specs=[
             pl.BlockSpec((k, d), lambda i: (0, 0)),
             pl.BlockSpec((1, k), lambda i: (0, 0)),
-            pl.BlockSpec((1,), lambda i: (0,)),
+            pl.BlockSpec((1, 128), lambda i: (0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((k, d), jnp.float32),
             jax.ShapeDtypeStruct((1, k), jnp.float32),
-            jax.ShapeDtypeStruct((1,), jnp.float32),
+            jax.ShapeDtypeStruct((1, 128), jnp.float32),
         ],
         interpret=interpret,
     )(x, c)
-    return sums, counts2d[0], cost1[0]
+    return (sums[:k_orig, :d_orig], counts2d[0, :k_orig], cost1[0, 0])
 
 
-def kmeans_stats(x: jax.Array, c: jax.Array, block_n: int = 1024
+def kmeans_stats(x: jax.Array, c: jax.Array, block_n: int = 256
                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Dispatch: pallas on TPU when shapes allow, XLA path otherwise."""
+    """Dispatch: pallas when opted in (HARP_USE_PALLAS=1) on TPU, else XLA.
+
+    Opt-in rather than default: the XLA path is already HBM-bandwidth-bound
+    optimal for this op on v5e (the two matmuls fuse well), while mosaic
+    compile time for large grids is minutes on remote-compile setups — pay it
+    only when you ask to.
+    """
+    import os
+
     on_tpu = jax.default_backend() == "tpu"
-    if _HAVE_PALLAS and on_tpu and x.shape[0] % block_n == 0:
+    opted = os.environ.get("HARP_USE_PALLAS", "") == "1"
+    if _HAVE_PALLAS and on_tpu and opted and x.shape[0] % block_n == 0:
         return kmeans_stats_pallas(x, c, block_n)
     return xla_path.partial_sums_counts(x, c)

@@ -1,0 +1,33 @@
+"""Smoke tests for the examples/ launchers (reference: ml/java examples/ +
+per-algorithm *Launcher classes run by contrib/test_scripts)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = dict(os.environ,
+           XLA_FLAGS="--xla_force_host_platform_device_count=8",
+           JAX_PLATFORMS="cpu")
+
+
+def test_collectives_tour_runs():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "collectives_tour.py")],
+        env=ENV, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "allreduce" in out.stdout and "rotate" in out.stdout
+
+
+def test_kmeans_launcher_cli(tmp_path):
+    work = str(tmp_path / "km")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "kmeans_launcher.py"),
+         "--cpu-mesh", "1000", "10", "20", "8", "5", work],
+        env=ENV, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    cen = np.loadtxt(os.path.join(work, "centroids.csv"), delimiter=",")
+    assert cen.shape == (10, 20)
+    assert "cost:" in out.stdout
