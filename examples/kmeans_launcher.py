@@ -13,7 +13,6 @@ semantics, minus the Hadoop plumbing:
 import argparse
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 

@@ -155,6 +155,30 @@ def gather(t: Table, root: int = 0, axis_name: str = WORKERS) -> Table:
     return t.with_data(out, Dist.LOCAL)
 
 
+def join(
+    dynamic: Table,
+    static: Table,
+    partitioner: Optional[partitioner_lib.Partitioner] = None,
+    axis_name: str = WORKERS,
+) -> Table:
+    """Co-locate a dynamic table with a static one (GraphCollective.join:313).
+
+    Harp routed the dynamic table's partitions to whichever worker held the
+    matching static partition (vertex tables joining edge tables). Here the
+    join is a regroup of the dynamic table; co-location holds ONLY when
+    ``partitioner`` is the same one used to shard the static table (a Table
+    does not carry its layout, so this contract is the caller's — pass None
+    iff the static table uses the default block layout).
+    """
+    _expect(static, Dist.SHARDED, "join(static)")
+    _expect(dynamic, Dist.LOCAL, "join(dynamic)")
+    if dynamic.num_partitions != static.num_partitions:
+        raise ValueError(
+            f"join requires matching partition counts: dynamic has "
+            f"{dynamic.num_partitions}, static has {static.num_partitions}")
+    return regroup(dynamic, partitioner, axis_name)
+
+
 def group_by_key(
     keys: jax.Array,
     values: jax.Array,

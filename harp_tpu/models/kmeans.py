@@ -40,7 +40,7 @@ import numpy as np
 
 from harp_tpu import combiner as cb
 from harp_tpu.collectives import lax_ops, rotation, table_ops
-from harp_tpu.ops import distance
+from harp_tpu.ops import distance, pallas_kernels
 from harp_tpu.session import HarpSession
 from harp_tpu.table import Table
 
@@ -78,8 +78,9 @@ class KMeans:
             cfg.compute_dtype)
 
         def estep(points, centroids, x_sq_sum=None):
-            sums, counts, sq = distance.partial_sums_counts(points, centroids,
-                                                            cdtype, x_sq_sum)
+            # dispatches to the fused pallas kernel when HARP_USE_PALLAS=1
+            sums, counts, sq = pallas_kernels.kmeans_stats(
+                points, centroids, compute_dtype=cdtype, x_sq_sum=x_sq_sum)
             stats = jnp.concatenate([sums, counts[:, None]], axis=1)  # (K, D+1)
             return stats, sq
 
@@ -88,7 +89,8 @@ class KMeans:
 
         def iter_body(centroids, points, x_sq_sum=None):
             if cfg.comm == "rotation":
-                new_c, sq = self._rotation_iter(points, centroids, k_pad, w)
+                new_c, sq = self._rotation_iter(points, centroids, k_pad, w,
+                                                x_sq_sum)
                 cost = jax.lax.psum(sq, lax_ops.WORKERS)
                 return new_c, cost
             stats, sq = estep(points, centroids, x_sq_sum)
@@ -135,15 +137,19 @@ class KMeans:
         return sess.spmd(fit_fn, in_specs=(sess.shard(), sess.replicate()),
                          out_specs=(sess.replicate(), sess.replicate()))
 
-    def _rotation_iter(self, points, centroids, k_pad, w):
+    def _rotation_iter(self, points, centroids, k_pad, w, x_sq_sum):
         """ml/java kmeans/rotation: centroid blocks circulate the ring; each worker
         scores its points against the resident block, tracking the block-local best;
         after a full cycle the global argmin resolves and stats are aggregated.
 
-        Padding rows (global id >= num_centroids) are zero-filled and masked out of
-        the distance matrix with +inf AFTER it is computed — padding with inf
-        coordinates would make pairwise_sq_dist produce NaN (inf - inf)."""
+        Uses the SAME score formulation (‖c‖² − 2x·c) as every other variant so
+        argmin tie-breaking is formulation-identical — the module's cross-variant
+        bit-identity claim depends on it. Padding rows (global id >=
+        num_centroids) are zero-filled and masked with +inf AFTER the score
+        matrix is computed."""
         cfg = self.config
+        cdtype = None if cfg.compute_dtype == "float32" else jnp.dtype(
+            cfg.compute_dtype)
         block = k_pad // w
         pad = k_pad - cfg.num_centroids
         cen_pad = jnp.pad(centroids, ((0, pad), (0, 0))) if pad else centroids
@@ -152,7 +158,7 @@ class KMeans:
 
         def body(carry, cen_block, t):
             best_d, best_id = carry
-            d = distance.pairwise_sq_dist(points, cen_block)  # (N, block)
+            d = distance.pairwise_scores(points, cen_block, cdtype)  # (N, block)
             # global centroid id of each column: owner shifts with rotation step
             src = (lax_ops.worker_id() - t) % w
             col_gid = src * block + jnp.arange(block)
@@ -176,7 +182,8 @@ class KMeans:
         full = table_ops.allreduce(Table.local(stats, num_workers=w))
         new_c = full.data[: cfg.num_centroids, :-1] / jnp.maximum(
             full.data[: cfg.num_centroids, -1:], 1.0)
-        return new_c, jnp.sum(best_d)
+        # best_d holds scores; true sq-distance cost adds the Σ‖x‖² constant
+        return new_c, jnp.sum(best_d) + x_sq_sum
 
     def fit(self, points: np.ndarray, centroids0: np.ndarray
             ) -> Tuple[jax.Array, jax.Array]:

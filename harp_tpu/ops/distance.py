@@ -46,6 +46,21 @@ def pairwise_sq_dist(x: jax.Array, c: jax.Array,
     return x2 - 2.0 * xc + c2
 
 
+def pairwise_scores(x: jax.Array, c: jax.Array,
+                    compute_dtype=None) -> jax.Array:
+    """Assignment scores ‖c‖² − 2x·c (N, K): same argmin ordering as
+    ``pairwise_sq_dist`` (the per-row ‖x‖² offset is constant), one x-read
+    cheaper. Used by every K-means variant so argmin tie-breaking is
+    formulation-identical across them."""
+    cf = c.astype(jnp.float32)
+    c2 = jnp.sum(cf * cf, axis=1)[None, :]
+    xm = x if compute_dtype is None else x.astype(compute_dtype)
+    cm = c if compute_dtype is None else c.astype(compute_dtype)
+    xc = jax.lax.dot_general(xm, cm, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    return c2 - 2.0 * xc
+
+
 def assign_clusters(x: jax.Array, c: jax.Array) -> jax.Array:
     """Nearest-centroid assignment (N,) int32."""
     return jnp.argmin(pairwise_sq_dist(x, c), axis=1).astype(jnp.int32)
@@ -70,13 +85,8 @@ def partial_sums_counts(
     # argmin over ‖x−c‖² == argmin over (‖c‖² − 2x·c): the per-row ‖x‖² term is
     # constant and never needs materializing — the E-step reads x exactly
     # twice (two MXU matmuls) and touches no (N, D)-sized temporaries.
-    cf = c.astype(jnp.float32)
-    c2 = jnp.sum(cf * cf, axis=1)[None, :]                # (1, K)
+    scores = pairwise_scores(x, c, compute_dtype)         # (N, K)
     xm = x if compute_dtype is None else x.astype(compute_dtype)
-    cm = c if compute_dtype is None else c.astype(compute_dtype)
-    xc = jax.lax.dot_general(xm, cm, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    scores = c2 - 2.0 * xc                                # (N, K)
     assign = jnp.argmin(scores, axis=1)
     min_s = jnp.min(scores, axis=1)
     oh_dtype = x.dtype if compute_dtype is None else compute_dtype

@@ -125,19 +125,23 @@ def kmeans_stats_pallas(
     return (sums[:k_orig, :d_orig], counts2d[0, :k_orig], cost1[0, 0])
 
 
-def kmeans_stats(x: jax.Array, c: jax.Array, block_n: int = 256
+def kmeans_stats(x: jax.Array, c: jax.Array, block_n: int = 256,
+                 compute_dtype=None, x_sq_sum=None
                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Dispatch: pallas when opted in (HARP_USE_PALLAS=1) on TPU, else XLA.
 
-    Opt-in rather than default: the XLA path is already HBM-bandwidth-bound
-    optimal for this op on v5e (the two matmuls fuse well), while mosaic
-    compile time for large grids is minutes on remote-compile setups — pay it
-    only when you ask to.
+    This is the E-step entry the K-means model calls. Opt-in rather than
+    default: the XLA path is already HBM-bandwidth-bound optimal for this op
+    on v5e (the two matmuls fuse well), while mosaic compile time for large
+    grids is minutes on remote-compile setups — pay it only when you ask to.
+    The pallas path computes in f32 and derives Σ‖x‖² in-kernel, so
+    ``compute_dtype``/``x_sq_sum`` apply to the XLA path only.
     """
     import os
 
     on_tpu = jax.default_backend() == "tpu"
     opted = os.environ.get("HARP_USE_PALLAS", "") == "1"
-    if _HAVE_PALLAS and on_tpu and opted and x.shape[0] % block_n == 0:
+    if (_HAVE_PALLAS and on_tpu and opted and x.shape[0] % block_n == 0
+            and x.dtype == jnp.float32):
         return kmeans_stats_pallas(x, c, block_n)
-    return xla_path.partial_sums_counts(x, c)
+    return xla_path.partial_sums_counts(x, c, compute_dtype, x_sq_sum)

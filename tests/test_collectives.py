@@ -290,3 +290,52 @@ class TestTablePadding:
                            out_specs=session.replicate())(contribs)
         assert out.shape == (13, 4)
         np.testing.assert_allclose(np.asarray(out), contribs.max(0), rtol=2e-5)
+
+
+class TestJoin:
+    def test_join_colocates_with_static(self, session, rng):
+        """GraphCollective.join parity: dynamic partitions land where the
+        matching static partitions live, combining contributions."""
+        import jax.numpy as jnp
+
+        from harp_tpu import Table
+        from harp_tpu.collectives import table_ops
+
+        w = session.num_workers
+        p = 2 * w
+
+        def prog(static_block, contrib):
+            static = Table.sharded(static_block, num_workers=w)
+            dynamic = Table.local(contrib, num_workers=w, name="dyn")
+            joined = table_ops.join(dynamic, static)
+            # joined block i must sit beside static block i: same local shape
+            return joined.data + 0.0 * static.data
+
+        static_full = np.arange(p * 3, dtype=np.float32).reshape(p, 3)
+        contrib = np.ones((p, 3), np.float32)
+        out = session.run(
+            prog, session.scatter(jnp.asarray(static_full)),
+            session.replicate_put(jnp.asarray(contrib)),
+            in_specs=(session.shard(), session.replicate()),
+            out_specs=session.shard())
+        # every worker contributed 1s for every partition -> combined value = W
+        np.testing.assert_allclose(np.asarray(out), np.full((p, 3), w))
+
+    def test_join_requires_matching_counts(self, session):
+        import jax.numpy as jnp
+
+        from harp_tpu import Table
+        from harp_tpu.collectives import table_ops
+
+        w = session.num_workers
+
+        def prog(static_block):
+            static = Table.sharded(static_block, num_workers=w)
+            dynamic = Table.local(jnp.ones((4 * w, 2)), num_workers=w)
+            return table_ops.join(dynamic, static).data
+
+        import pytest
+
+        with pytest.raises(ValueError, match="matching partition counts"):
+            session.run(prog, session.scatter(jnp.ones((w, 2))),
+                        in_specs=(session.shard(),), out_specs=session.shard())

@@ -42,7 +42,8 @@ def test_variants_agree_exactly(session, data):
     """All comm patterns compute the same sums → identical trajectories."""
     pts, cen0 = data
     outs = {}
-    for comm in ("regroupallgather", "allreduce", "bcastreduce"):
+    for comm in ("regroupallgather", "allreduce", "bcastreduce", "pushpull",
+                 "rotation"):
         model = km.KMeans(session, km.KMeansConfig(K, D, ITERS, comm))
         cen, _ = model.fit(pts, cen0)
         outs[comm] = np.asarray(cen)
