@@ -90,7 +90,7 @@ class KMeans:
         def iter_body(centroids, points, x_sq_sum=None):
             if cfg.comm == "rotation":
                 new_c, sq = self._rotation_iter(points, centroids, k_pad, w,
-                                                x_sq_sum)
+                                                x_sq_sum, cdtype)
                 cost = jax.lax.psum(sq, lax_ops.WORKERS)
                 return new_c, cost
             stats, sq = estep(points, centroids, x_sq_sum)
@@ -137,7 +137,7 @@ class KMeans:
         return sess.spmd(fit_fn, in_specs=(sess.shard(), sess.replicate()),
                          out_specs=(sess.replicate(), sess.replicate()))
 
-    def _rotation_iter(self, points, centroids, k_pad, w, x_sq_sum):
+    def _rotation_iter(self, points, centroids, k_pad, w, x_sq_sum, cdtype):
         """ml/java kmeans/rotation: centroid blocks circulate the ring; each worker
         scores its points against the resident block, tracking the block-local best;
         after a full cycle the global argmin resolves and stats are aggregated.
@@ -148,8 +148,6 @@ class KMeans:
         num_centroids) are zero-filled and masked with +inf AFTER the score
         matrix is computed."""
         cfg = self.config
-        cdtype = None if cfg.compute_dtype == "float32" else jnp.dtype(
-            cfg.compute_dtype)
         block = k_pad // w
         pad = k_pad - cfg.num_centroids
         cen_pad = jnp.pad(centroids, ((0, pad), (0, 0))) if pad else centroids
@@ -166,7 +164,9 @@ class KMeans:
             dmin = jnp.min(d, axis=1)
             darg = jnp.argmin(d, axis=1)
             gid = src * block + darg
-            upd = dmin < best_d
+            # tie-break on global id so ties resolve like jnp.argmin's
+            # lowest-index rule in the non-rotation variants (bit-identity)
+            upd = (dmin < best_d) | ((dmin == best_d) & (gid < best_id))
             return (jnp.where(upd, dmin, best_d),
                     jnp.where(upd, gid, best_id)), cen_block
 

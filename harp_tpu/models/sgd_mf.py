@@ -51,6 +51,8 @@ class SGDMFConfig:
     lr: float = 0.05           # learning rate (reference: epsilon)
     epochs: int = 10
     minibatches_per_hop: int = 4  # bounded-staleness stand-in for the dymoro timer
+    num_slices: int = 1        # 2 = double-buffered pipeline (reference:
+    #                            numModelSlices=2, dymoro comm/compute overlap)
 
 
 def bucketize(
@@ -61,37 +63,41 @@ def bucketize(
     num_rows: int,
     num_cols: int,
     minibatches: int,
+    num_col_blocks: int = 0,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int, int]:
-    """Host-side layout: COO ratings → (W, W, M) padded buckets.
+    """Host-side layout: COO ratings → (W, B, M) padded buckets.
 
     Bucket (w, b) holds the ratings whose row lives on worker w and whose column
     lives in H block b, with row/col indices localized to the block. This replaces
     the reference's regroup of VSets (SGDCollectiveMapper regroup-vw:384): the
     shuffle happens once on the host, the device program is static.
+    ``num_col_blocks`` defaults to W (one H block per worker); the 2-slice
+    pipeline uses 2W.
     """
     w = num_workers
+    b_blocks = num_col_blocks or w
     rpw = -(-num_rows // w)        # rows per worker (ceil)
-    cpb = -(-num_cols // w)        # cols per block
+    cpb = -(-num_cols // b_blocks)  # cols per block
     owner = rows // rpw
     block = cols // cpb
     # One sort-based pass: order entries by (owner, block), then lay each bucket
     # out contiguously — O(nnz log nnz), not O(W^2 * nnz).
-    bucket = owner.astype(np.int64) * w + block
+    bucket = owner.astype(np.int64) * b_blocks + block
     order = np.argsort(bucket, kind="stable")
-    counts = np.bincount(bucket, minlength=w * w)
+    counts = np.bincount(bucket, minlength=w * b_blocks)
     m = max(int(counts.max()), 1) if counts.size else 1
     m = -(-m // minibatches) * minibatches   # pad so hops split evenly
-    r_idx = np.zeros((w, w, m), np.int32)
-    c_idx = np.zeros((w, w, m), np.int32)
-    val = np.zeros((w, w, m), np.float32)
-    mask = np.zeros((w, w, m), np.float32)
+    r_idx = np.zeros((w, b_blocks, m), np.int32)
+    c_idx = np.zeros((w, b_blocks, m), np.int32)
+    val = np.zeros((w, b_blocks, m), np.float32)
+    mask = np.zeros((w, b_blocks, m), np.float32)
     starts = np.concatenate([[0], np.cumsum(counts)])
     rs, cs, vs = rows[order], cols[order], vals[order]
-    for b in range(w * w):
+    for b in range(w * b_blocks):
         lo, hi = starts[b], starts[b + 1]
         if lo == hi:
             continue
-        wi, bi = divmod(b, w)
+        wi, bi = divmod(b, b_blocks)
         k = hi - lo
         r_idx[wi, bi, :k] = rs[lo:hi] - wi * rpw
         c_idx[wi, bi, :k] = cs[lo:hi] - bi * cpb
@@ -111,20 +117,20 @@ class SGDMF:
     def _build(self, w: int, nmb: int, mbs: int):
         cfg = self.config
         lr, lam = cfg.lr, cfg.lam
+        two_slice = cfg.num_slices == 2
 
         def fit_fn(r_idx, c_idx, val, mask, w0, h0):
-            # Sharded bucket blocks arrive as (1, W, M): leading axis is this
-            # worker's shard of the worker axis.
+            # Sharded bucket blocks arrive as (1, B, M): leading axis is this
+            # worker's shard of the worker axis (B = num_slices * W).
             r_idx, c_idx, val, mask = r_idx[0], c_idx[0], val[0], mask[0]
 
-            def hop_body(carry, h_block, t):
-                w_local, sse, cnt = carry
-                wid = lax_ops.worker_id()
-                src = (wid - t) % w                 # home worker of resident block
-                r = jnp.take(r_idx, src, axis=0).reshape(nmb, mbs)
-                c = jnp.take(c_idx, src, axis=0).reshape(nmb, mbs)
-                v = jnp.take(val, src, axis=0).reshape(nmb, mbs)
-                msk = jnp.take(mask, src, axis=0).reshape(nmb, mbs)
+            def update_bucket(w_local, h_block, sse, cnt, bucket_id):
+                """Run the minibatched SGD updates of one (worker, block)
+                bucket against the resident H block."""
+                r = jnp.take(r_idx, bucket_id, axis=0).reshape(nmb, mbs)
+                c = jnp.take(c_idx, bucket_id, axis=0).reshape(nmb, mbs)
+                v = jnp.take(val, bucket_id, axis=0).reshape(nmb, mbs)
+                msk = jnp.take(mask, bucket_id, axis=0).reshape(nmb, mbs)
 
                 def mb_step(state, xs):
                     wl, hb, sse, cnt = state
@@ -137,23 +143,50 @@ class SGDMF:
                         lr * (err[:, None] * hc - lam * wr * mm[:, None]))
                     hb = hb.at[cm].add(
                         lr * (err[:, None] * wr - lam * hc * mm[:, None]))
-                    return (wl, hb, sse + jnp.sum(err * err), cnt + jnp.sum(mm)), None
+                    return (wl, hb, sse + jnp.sum(err * err),
+                            cnt + jnp.sum(mm)), None
 
                 (w_local, h_block, sse, cnt), _ = jax.lax.scan(
                     mb_step, (w_local, h_block, sse, cnt), (r, c, v, msk))
+                return w_local, h_block, sse, cnt
+
+            def hop_body(carry, h_block, t):
+                w_local, sse, cnt = carry
+                wid = lax_ops.worker_id()
+                if two_slice:
+                    # dymoro pipeline (Rotator, numModelSlices=2): resident
+                    # slice s = t%2 has been shifted t//2 times; compute on it
+                    # while the other slice's ppermute is in flight.
+                    s = t % 2
+                    src = (wid - t // 2) % w
+                    bucket_id = s * w + src
+                else:
+                    bucket_id = (wid - t) % w       # home worker of resident
+                w_local, h_block, sse, cnt = update_bucket(
+                    w_local, h_block, sse, cnt, bucket_id)
                 return (w_local, sse, cnt), h_block
 
+            rotator = rotation.Rotator(w, cfg.num_slices)
+
             def epoch(state, _):
-                w_local, h_block = state
-                (w_local, sse, cnt), h_block = rotation.rotate_scan(
-                    hop_body, (w_local, jnp.zeros(()), jnp.zeros(())), h_block, w)
+                w_local, h = state
+                carry0 = (w_local, jnp.zeros(()), jnp.zeros(()))
+                slices = h if two_slice else (h,)
+                (w_local, sse, cnt), out = rotator.run(hop_body, carry0,
+                                                       slices)
+                h = out if two_slice else out[0]
                 sse = jax.lax.psum(sse, lax_ops.WORKERS)
                 cnt = jax.lax.psum(cnt, lax_ops.WORKERS)
-                return (w_local, h_block), jnp.sqrt(sse / jnp.maximum(cnt, 1.0))
+                return (w_local, h), jnp.sqrt(sse / jnp.maximum(cnt, 1.0))
 
-            (w_local, h_block), rmse = jax.lax.scan(
-                epoch, (w0, h0), None, length=cfg.epochs)
-            return w_local, h_block, rmse
+            # two-slice h0 arrives as this worker's (1, 2, cpb, K) chunk:
+            # slice A block w and slice B block W+w
+            h_init = (h0[0, 0], h0[0, 1]) if two_slice else h0
+            (w_local, h_fin), rmse = jax.lax.scan(
+                epoch, (w0, h_init), None, length=cfg.epochs)
+            if two_slice:
+                h_fin = jnp.stack(h_fin, axis=0)[None]   # (1, 2, cpb, K)
+            return w_local, h_fin, rmse
 
         sess = self.session
         return sess.spmd(
@@ -170,31 +203,49 @@ class SGDMF:
         Returns an opaque state tuple for :meth:`fit_prepared` — keeps host
         prep and H2D transfer out of timed regions (KMeans.prepare idiom)."""
         cfg = self.config
+        if cfg.num_slices not in (1, 2):
+            raise ValueError("num_slices must be 1 or 2")
         sess = self.session
         w = sess.num_workers
+        n_blocks = cfg.num_slices * w
         r_idx, c_idx, val, mask, rpw, cpb = bucketize(
-            rows, cols, vals, w, num_rows, num_cols, cfg.minibatches_per_hop)
+            rows, cols, vals, w, num_rows, num_cols, cfg.minibatches_per_hop,
+            num_col_blocks=n_blocks)
         m = r_idx.shape[2]
         nmb = cfg.minibatches_per_hop
         mbs = m // nmb
-        key = (w, nmb, mbs)
+        key = (w, nmb, mbs, cfg.num_slices)
         if key not in self._compiled:
             self._compiled[key] = self._build(w, nmb, mbs)
 
         rng = np.random.default_rng(seed)
         scale = 1.0 / np.sqrt(cfg.rank)
         w0 = (scale * rng.standard_normal((w * rpw, cfg.rank))).astype(np.float32)
-        h0 = (scale * rng.standard_normal((w * cpb, cfg.rank))).astype(np.float32)
+        h0 = (scale * rng.standard_normal(
+            (n_blocks * cpb, cfg.rank))).astype(np.float32)
+        if cfg.num_slices == 2:
+            # global block b = s*W + w' → worker w' holds (slice s, block w'):
+            # lay out worker-major (W, 2, cpb, K) so scatter gives each worker
+            # its two resident blocks
+            h0_dev = sess.scatter(np.ascontiguousarray(
+                h0.reshape(2, w, cpb, cfg.rank).transpose(1, 0, 2, 3)))
+        else:
+            h0_dev = sess.scatter(h0)
         return (key, sess.scatter(r_idx), sess.scatter(c_idx),
                 sess.scatter(val), sess.scatter(mask), sess.scatter(w0),
-                sess.scatter(h0), num_rows, num_cols)
+                h0_dev, num_rows, num_cols)
 
     def fit_prepared(self, state) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Run training on already-placed device data (no host prep)."""
         key, r_idx, c_idx, val, mask, w0, h0, num_rows, num_cols = state
         out_w, out_h, rmse = self._compiled[key](r_idx, c_idx, val, mask, w0,
                                                  h0)
-        return (np.asarray(out_w)[:num_rows], np.asarray(out_h)[:num_cols],
+        out_h = np.asarray(out_h)
+        if key[3] == 2:
+            # (W, 2, cpb, K) worker-major → block-id-major (2W*cpb, K)
+            w_, _, cpb, k = out_h.shape
+            out_h = out_h.transpose(1, 0, 2, 3).reshape(2 * w_ * cpb, k)
+        return (np.asarray(out_w)[:num_rows], out_h[:num_cols],
                 np.asarray(rmse))
 
     def fit(self, rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
