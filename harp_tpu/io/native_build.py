@@ -35,7 +35,12 @@ def build(force: bool = False) -> str | None:
         return None
     cmd = [cxx, "-O3", "-std=c++17", "-fPIC", "-pthread", "-Wall", "-shared",
            "-o", out, src]
-    subprocess.run(cmd, check=True)
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        # A failing compile degrades to the numpy fallback exactly like the
+        # no-compiler path, rather than crashing the caller.
+        print(f"native loader compile failed:\n{proc.stderr}", file=sys.stderr)
+        return None
     return out
 
 

@@ -74,6 +74,15 @@ def bucketize(
     ``num_col_blocks`` defaults to W (one H block per worker); the 2-slice
     pipeline uses 2W.
     """
+    if len(rows):
+        if rows.min() < 0 or rows.max() >= num_rows:
+            raise ValueError(
+                f"row indices must be in [0, {num_rows}); got "
+                f"[{rows.min()}, {rows.max()}]")
+        if cols.min() < 0 or cols.max() >= num_cols:
+            raise ValueError(
+                f"col indices must be in [0, {num_cols}); got "
+                f"[{cols.min()}, {cols.max()}]")
     w = num_workers
     b_blocks = num_col_blocks or w
     rpw = -(-num_rows // w)        # rows per worker (ceil)

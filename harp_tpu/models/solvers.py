@@ -173,18 +173,27 @@ class Solver:
     @staticmethod
     def _objective_key(objective):
         """Cache key that treats re-created but identical lambdas as equal
-        (same code object + same closure values), so loops over minimize()
-        don't accumulate recompiled programs."""
+        (same code object + same closure values + same referenced-global
+        values), so loops over minimize() don't accumulate recompiled
+        programs. Globals named in co_names are part of the key: two
+        objectives with identical code can still differ via a module-level
+        constant, and a mutated global between minimize() calls must not
+        silently reuse the stale compiled program."""
         code = getattr(objective, "__code__", None)
         if code is None:
             return objective
         cells = getattr(objective, "__closure__", None) or ()
+        gl = getattr(objective, "__globals__", {})
+        defaults = getattr(objective, "__defaults__", None) or ()
         try:
             contents = tuple(c.cell_contents for c in cells)
-            hash(contents)
+            ref_globals = tuple(
+                (name, gl[name]) for name in code.co_names if name in gl)
+            key = (code, contents, ref_globals, defaults)
+            hash(key)
         except Exception:
             return objective
-        return (code, contents)
+        return key
 
     def minimize(self, objective: Callable, x: np.ndarray, y: np.ndarray,
                  theta0: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:

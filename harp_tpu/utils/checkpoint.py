@@ -73,6 +73,16 @@ class Checkpointer:
     def restore(self, step: int, like: Optional[Any] = None) -> Any:
         path = self._step_dir(step)
         if self.use_orbax:
+            if like is not None:
+                # Restore INTO the `like` structure so container types
+                # (tuples, NamedTuples, dataclass pytrees) round-trip
+                # identically on both backends.
+                try:
+                    restored = self._ckptr.restore(path, item=like)
+                except TypeError:  # newer orbax dropped the item= kwarg
+                    restored = self._ckptr.restore(path)
+                treedef = jax.tree.structure(like)
+                return jax.tree.unflatten(treedef, jax.tree.leaves(restored))
             return self._ckptr.restore(path)
         data = np.load(os.path.join(path, "arrays.npz"))
         leaves = [data[str(i)] for i in range(len(data.files))]
