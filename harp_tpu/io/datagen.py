@@ -48,6 +48,39 @@ def sparse_ratings(num_users: int, num_items: int, rank: int,
     return rows, cols, vals.astype(np.float32)
 
 
+def zipf_ratings(num_users: int, num_items: int, rank: int,
+                 alpha: float = 1.3, density: float = 0.05, seed: int = 0,
+                 noise: float = 0.01
+                 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Power-law rating sample: user AND item popularity are Zipf(alpha)
+    distributed — the skew profile of the reference's marquee datasets
+    (clueweb; HarpDAALDataSource.regroupCOOList:399 regrouped exactly such
+    data). Exercises hot-row/hot-column behavior of sparse layouts."""
+    rng = np.random.default_rng(seed)
+    nnz = int(num_users * num_items * density)
+    pu = (np.arange(1, num_users + 1, dtype=np.float64)) ** -alpha
+    pi = (np.arange(1, num_items + 1, dtype=np.float64)) ** -alpha
+    # real rating matrices have UNIQUE (user, item) pairs — sample with
+    # replacement, dedupe, top up (duplicates would concentrate in single
+    # cells, which no partitioning could ever spread)
+    seen: np.ndarray = np.empty(0, np.int64)
+    for _ in range(8):
+        need = nnz - len(seen)
+        if need <= 0:
+            break
+        r = rng.choice(num_users, size=2 * need, p=pu / pu.sum())
+        c = rng.choice(num_items, size=2 * need, p=pi / pi.sum())
+        seen = np.unique(np.concatenate([seen, r * num_items + c]))
+    seen = rng.permutation(seen)[:nnz]   # may fall short of nnz at high density
+    rows = (seen // num_items).astype(np.int32)
+    cols = (seen % num_items).astype(np.int32)
+    u = rng.standard_normal((num_users, rank)).astype(np.float32) / np.sqrt(rank)
+    v = rng.standard_normal((num_items, rank)).astype(np.float32) / np.sqrt(rank)
+    vals = (np.einsum("ij,ij->i", u[rows], v[cols])
+            + noise * rng.standard_normal(len(rows)))
+    return rows, cols, vals.astype(np.float32)
+
+
 def lda_corpus(num_docs: int, vocab: int, num_topics: int, doc_len: int,
                seed: int = 0, alpha: float = 0.1, beta: float = 0.01
                ) -> np.ndarray:
