@@ -17,7 +17,13 @@ TPU-native re-expression:
   to XLA (SURVEY §7 "hard parts"). Reformulated as **bounded staleness**: each hop
   runs a fixed number of mini-batch SGD steps over that (worker, block) bucket of
   ratings. Convergence-equivalent, not step-equivalent; Harp itself only claims
-  statistical semantics for its racy Hogwild-style updates.
+  statistical semantics for its racy Hogwild-style updates. The per-hop budget can
+  be auto-tuned between epochs by :class:`HopBudgetTuner` /
+  :meth:`SGDMF.fit_adaptive` — the analog of the reference's
+  ``adjustMiniBatch``/``setTimer`` (SGDCollectiveMapper.java:281-287, :623):
+  buckets are padded to a multiple of ``minibatches_per_hop``, so every divisor
+  is a valid budget over the SAME device-resident data (a "banded" shape family
+  — switching budgets swaps compiled programs, never re-lays-out or re-uploads).
 
 Two data layouts, selected by density (``SGDMFConfig.layout``):
 
@@ -131,6 +137,7 @@ def bucketize(
     num_col_blocks: int = 0,
     row_assign: Optional[Tuple[np.ndarray, np.ndarray]] = None,
     col_assign: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+    validate: bool = True,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int, int]:
     """Host-side layout: COO ratings → (W, B, M) padded buckets.
 
@@ -142,7 +149,8 @@ def bucketize(
     pipeline uses 2W. ``row_assign``/``col_assign`` are optional (bin, slot)
     id maps (see :func:`serpentine_assign`); default is contiguous ranges.
     """
-    _validate_coo(rows, cols, num_rows, num_cols)
+    if validate:
+        _validate_coo(rows, cols, num_rows, num_cols)
     w = num_workers
     b_blocks = num_col_blocks or w
     rpw = -(-num_rows // w)        # rows per worker (ceil)
@@ -190,6 +198,7 @@ class SGDMF:
         self.session = session
         self.config = config
         self._compiled = {}       # layout/shape key -> compiled SPMD program
+        self._warm: set = set()   # keys pre-compiled via AOT lower (fit_adaptive)
         self.last_layout_stats: dict = {}
 
     # -- schedule (shared by both layouts) ----------------------------------- #
@@ -207,7 +216,7 @@ class SGDMF:
         return (wid - t) % w
 
     def _build(self, w: int, num_data_args: int,
-               make_update_bucket: Callable):
+               make_update_bucket: Callable, epochs: int):
         """Shared rotation/epoch harness for both layouts.
 
         ``make_update_bucket(local_data)`` receives the worker-local shards of
@@ -247,7 +256,7 @@ class SGDMF:
             # slice A block w and slice B block W+w
             h_init = (h0[0, 0], h0[0, 1]) if two_slice else h0
             (w_local, h_fin), rmse = jax.lax.scan(
-                epoch, (w0, h_init), None, length=cfg.epochs)
+                epoch, (w0, h_init), None, length=epochs)
             if two_slice:
                 h_fin = jnp.stack(h_fin, axis=0)[None]   # (1, 2, cpb, K)
             return w_local, h_fin, rmse
@@ -261,7 +270,7 @@ class SGDMF:
 
     # -- sparse (padded COO bucket) program ----------------------------------- #
 
-    def _build_sparse(self, w: int, nmb: int, mbs: int):
+    def _build_sparse(self, w: int, nmb: int, mbs: int, epochs: int):
         lr, lam = self.config.lr, self.config.lam
 
         def make_update_bucket(data):
@@ -295,11 +304,12 @@ class SGDMF:
 
             return update_bucket
 
-        return self._build(w, 4, make_update_bucket)
+        return self._build(w, 4, make_update_bucket, epochs)
 
     # -- dense (masked stripe-GEMM) program ------------------------------------ #
 
-    def _build_dense(self, w: int, nmb: int, rpw: int, cpb: int):
+    def _build_dense(self, w: int, nmb: int, nmb_fine: int, rpw: int,
+                     cpb: int, epochs: int):
         lr, lam = self.config.lr, self.config.lam
         s_rows = rpw // nmb
         bf = jnp.bfloat16
@@ -311,7 +321,10 @@ class SGDMF:
                 vb = jnp.take(v_slab, bucket_id, axis=0)     # (rpw, cpb) bf16
                 mb = jnp.take(m_slab, bucket_id, axis=0)
                 rcnt = jnp.take(row_cnt, bucket_id, axis=0)  # (rpw,)
-                ccnt = jnp.take(col_cnt, bucket_id, axis=0)  # (nmb, cpb)
+                # col counts are stored at the finest stripe granularity
+                # (nmb_fine, cpb); coarser budgets sum adjacent fine stripes
+                ccnt = jnp.take(col_cnt, bucket_id, axis=0)
+                ccnt = ccnt.reshape(nmb, nmb_fine // nmb, cpb).sum(axis=1)
 
                 def stripe(state, xs):
                     hb, sse = state
@@ -346,7 +359,34 @@ class SGDMF:
 
             return update_bucket
 
-        return self._build(w, 4, make_update_bucket)
+        return self._build(w, 4, make_update_bucket, epochs)
+
+    def _program(self, layout: str, nmb: int, epochs: int, geom: Tuple):
+        """Compile (or fetch) the SPMD program for a given per-hop budget.
+
+        ``geom`` is the layout geometry captured at prepare time — buckets are
+        padded to a multiple of ``minibatches_per_hop``, so every divisor
+        ``nmb`` yields a valid program over the same device arrays."""
+        w = self.session.num_workers
+        if layout == "sparse":
+            (m_total,) = geom
+            if m_total % nmb:
+                raise ValueError(f"budget {nmb} does not divide bucket {m_total}")
+            key = ("sparse", w, nmb, m_total // nmb, self.config.num_slices,
+                   epochs)
+            if key not in self._compiled:
+                self._compiled[key] = self._build_sparse(
+                    w, nmb, m_total // nmb, epochs)
+        else:
+            nmb_fine, rpw, cpb = geom
+            if nmb_fine % nmb:
+                raise ValueError(f"budget {nmb} does not divide band {nmb_fine}")
+            key = ("dense", w, nmb, nmb_fine, rpw, cpb,
+                   self.config.num_slices, epochs)
+            if key not in self._compiled:
+                self._compiled[key] = self._build_dense(
+                    w, nmb, nmb_fine, rpw, cpb, epochs)
+        return key
 
     # -- preparation ----------------------------------------------------------- #
 
@@ -378,6 +418,9 @@ class SGDMF:
         cfg = self.config
         if cfg.num_slices not in (1, 2):
             raise ValueError("num_slices must be 1 or 2")
+        if cfg.layout not in ("auto", "dense", "sparse"):
+            raise ValueError(f"layout must be auto|dense|sparse, got "
+                             f"{cfg.layout!r}")
         _validate_coo(rows, cols, num_rows, num_cols)
         # keep-first dedupe for BOTH layouts: identical training sets
         dropped = 0
@@ -432,25 +475,20 @@ class SGDMF:
         r_idx, c_idx, val, mask, rpw, cpb = bucketize(
             rows, cols, vals, w, num_rows, num_cols, cfg.minibatches_per_hop,
             num_col_blocks=n_blocks, row_assign=row_assign,
-            col_assign=col_assign)
+            col_assign=col_assign, validate=False)
         nnz = max(len(vals), 1)
         self.last_layout_stats = {
             "layout": "sparse", "padded": int(r_idx.size),
             "nnz": len(vals), "overhead": r_idx.size / nnz,
         }
-        m = r_idx.shape[2]
-        nmb = cfg.minibatches_per_hop
-        mbs = m // nmb
-        key = ("sparse", w, nmb, mbs, cfg.num_slices)
-        if key not in self._compiled:
-            self._compiled[key] = self._build_sparse(w, nmb, mbs)
+        geom = (r_idx.shape[2],)
 
         rng = np.random.default_rng(seed)
         w0, h0 = self._init_factors(rng, w * rpw, n_blocks * cpb)
-        return ("sparse", key, (sess.scatter(r_idx), sess.scatter(c_idx),
-                                sess.scatter(val), sess.scatter(mask)),
+        return ("sparse", (sess.scatter(r_idx), sess.scatter(c_idx),
+                           sess.scatter(val), sess.scatter(mask)),
                 sess.scatter(w0), self._place_h0(h0, w, cpb),
-                (num_rows, num_cols, row_assign, col_assign, rpw, cpb))
+                (num_rows, num_cols, row_assign, col_assign, rpw, cpb, geom))
 
     def _prepare_dense(self, rows, cols, vals, num_rows, num_cols, seed):
         cfg = self.config
@@ -484,6 +522,12 @@ class SGDMF:
             msk_p[wi, :hi - lo] = 1.0
 
         slab_elems = n_blocks * rpw * cpb
+        if slab_elems >= 2 ** 31:
+            # device indices are int32 (jax x64 off): a bigger slab would
+            # silently wrap and drop entries in the scatter
+            raise ValueError(
+                f"dense slab has {slab_elems} elements per worker (>= 2^31); "
+                "use layout='sparse' or more workers")
 
         def densify(idx, val, msk):
             # scatter directly in bf16 — indices are unique (deduped in
@@ -520,38 +564,80 @@ class SGDMF:
             "layout": "dense", "padded": int(w) * slab_elems,
             "nnz": len(vals), "overhead": w * slab_elems / max(len(vals), 1),
         }
-        key = ("dense", w, nmb, rpw, cpb, cfg.num_slices)
-        if key not in self._compiled:
-            self._compiled[key] = self._build_dense(w, nmb, rpw, cpb)
+        geom = (nmb, rpw, cpb)
 
         rng = np.random.default_rng(seed)
         w0, h0 = self._init_factors(rng, w * rpw, n_blocks * cpb)
-        return ("dense", key,
+        return ("dense",
                 (v_slab, m_slab, sess.scatter(row_cnt), sess.scatter(col_cnt)),
                 sess.scatter(w0), self._place_h0(h0, w, cpb),
-                (num_rows, num_cols, row_assign, col_assign, rpw, cpb))
+                (num_rows, num_cols, row_assign, col_assign, rpw, cpb, geom))
 
     # -- training -------------------------------------------------------------- #
 
-    def fit_prepared(self, state) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """Run training on already-placed device data (no host prep)."""
-        layout, key, data, w0, h0, meta = state
-        num_rows, num_cols, row_assign, col_assign, rpw, cpb = meta
-        out_w, out_h, rmse = self._compiled[key](*data, w0, h0)
+    def _finalize(self, out_w, out_h, meta):
+        """Device factor blocks → (num_rows, K)/(num_cols, K) in original id
+        order (undo the worker/block permutation)."""
+        num_rows, num_cols, row_assign, col_assign, rpw, cpb = meta[:6]
         out_w = np.asarray(out_w)
         out_h = np.asarray(out_h)
         if self.config.num_slices == 2:
             # (W, 2, cpb, K) worker-major → block-id-major (2W*cpb, K)
             w_, _, cpb_, k = out_h.shape
             out_h = out_h.transpose(1, 0, 2, 3).reshape(2 * w_ * cpb_, k)
-        # un-permute factors back to original id order
         w_flat = out_w.reshape(-1, out_w.shape[-1])
         rb, rl = row_assign
         w_final = w_flat[rb[:num_rows].astype(np.int64) * rpw
                          + rl[:num_rows]]
         cb, cl = col_assign
         h_final = out_h[cb[:num_cols].astype(np.int64) * cpb + cl[:num_cols]]
+        return w_final, h_final
+
+    def fit_prepared(self, state) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Run training on already-placed device data (no host prep)."""
+        layout, data, w0, h0, meta = state
+        key = self._program(layout, self.config.minibatches_per_hop,
+                            self.config.epochs, meta[6])
+        out_w, out_h, rmse = self._compiled[key](*data, w0, h0)
+        w_final, h_final = self._finalize(out_w, out_h, meta)
         return w_final, h_final, np.asarray(rmse)
+
+    def fit_adaptive(self, state, tuner: Optional["HopBudgetTuner"] = None,
+                     epochs: Optional[int] = None
+                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                "HopBudgetTuner"]:
+        """Train with an auto-tuned per-hop budget (reference:
+        ``adjustMiniBatch``/``setTimer``, SGDCollectiveMapper.java:281-287).
+
+        Runs one compiled epoch per host step, measures it, and lets the
+        tuner pick the next budget among the divisors of
+        ``minibatches_per_hop``. Programs for each budget are compiled once
+        (ahead of the timed region) and reuse the same device data — the
+        banded-shape property of the bucket padding."""
+        import time as _time
+
+        layout, data, w0, h0, meta = state
+        geom = meta[6]
+        nmb_fine = self.config.minibatches_per_hop
+        cands = [d for d in range(1, nmb_fine + 1) if nmb_fine % d == 0]
+        tuner = tuner or HopBudgetTuner(cands)
+        epochs = epochs if epochs is not None else self.config.epochs
+        w_cur, h_cur = w0, h0
+        rmses = []
+        for _ in range(epochs):
+            nmb = tuner.next_budget()
+            key = self._program(layout, nmb, 1, geom)
+            fn = self._compiled[key]
+            if key not in self._warm:
+                fn.lower(*data, w_cur, h_cur).compile()  # keep compile untimed
+                self._warm.add(key)
+            t0 = _time.perf_counter()
+            w_cur, h_cur, r = fn(*data, w_cur, h_cur)
+            r = np.asarray(r)        # fetch forces execution (remote platforms)
+            tuner.record(nmb, _time.perf_counter() - t0)
+            rmses.append(r[0])
+        w_final, h_final = self._finalize(w_cur, h_cur, meta)
+        return w_final, h_final, np.asarray(rmses), tuner
 
     def fit(self, rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
             num_rows: int, num_cols: int, seed: int = 0
@@ -559,6 +645,44 @@ class SGDMF:
         """Train; returns (W (num_rows, K), H (num_cols, K), rmse-per-epoch)."""
         return self.fit_prepared(self.prepare(rows, cols, vals, num_rows,
                                               num_cols, seed))
+
+
+class HopBudgetTuner:
+    """Chooses the per-hop minibatch budget from measured epoch times.
+
+    Policy (mirrors the intent of the reference's adaptive timer,
+    SGDCollectiveMapper.adjustMiniBatch:623): more minibatches per hop =
+    more sequential SGD steps = better convergence per epoch, but smaller
+    device ops. Sweep each candidate once, then exploit the LARGEST budget
+    whose time is within ``slack`` of the fastest, refining the estimate of
+    the chosen budget with an EWMA each epoch."""
+
+    def __init__(self, candidates, slack: float = 0.2):
+        if not candidates:
+            raise ValueError("need at least one candidate budget")
+        self.candidates = sorted(set(int(c) for c in candidates))
+        self.slack = slack
+        self.times: dict = {}
+        self._sweep = list(self.candidates)
+
+    def next_budget(self) -> int:
+        return self._sweep[0] if self._sweep else self.chosen
+
+    @property
+    def chosen(self) -> int:
+        if not self.times:
+            return self.candidates[-1]
+        best = min(self.times.values())
+        ok = [c for c in self.candidates
+              if self.times.get(c, np.inf) <= best * (1 + self.slack)]
+        return max(ok) if ok else self.candidates[-1]
+
+    def record(self, budget: int, seconds: float) -> None:
+        if self._sweep and self._sweep[0] == budget:
+            self._sweep.pop(0)
+        prev = self.times.get(budget)
+        self.times[budget] = (seconds if prev is None
+                              else 0.7 * prev + 0.3 * seconds)
 
 
 def numpy_rmse(w_f: np.ndarray, h_f: np.ndarray, rows, cols, vals) -> float:

@@ -116,6 +116,42 @@ def test_dense_and_sparse_layouts_agree(session):
     assert abs(finals["dense"] - finals["sparse"]) < 0.06
 
 
+def test_hop_budget_tuner_policy():
+    """adjustMiniBatch analog: sweeps once, then settles on the largest budget
+    within slack of the fastest; EWMA tracks drift."""
+    t = sgd_mf.HopBudgetTuner([1, 2, 4, 8], slack=0.2)
+    # sweep order is ascending candidates
+    sweep = [t.next_budget() for _ in range(4)]
+    for nmb, sec in zip([1, 2, 4, 8], [1.0, 1.0, 1.1, 2.0]):
+        assert t.next_budget() == nmb
+        t.record(nmb, sec)
+    assert sweep[0] == 1
+    # 4 is within 20% of the best (1.0) -> pick the LARGEST qualifying budget
+    assert t.chosen == 4
+    assert t.next_budget() == 4
+    # drift: budget 4 becomes slow; EWMA pushes choice down
+    for _ in range(12):
+        t.record(4, 3.0)
+    assert t.chosen == 2
+
+
+def test_fit_adaptive_converges_and_tunes(session):
+    rows, cols, vals = datagen.sparse_ratings(
+        num_users=96, num_items=80, rank=4, density=0.25, seed=3, noise=0.01)
+    cfg = sgd_mf.SGDMFConfig(rank=8, lam=0.01, lr=0.08, epochs=16,
+                             minibatches_per_hop=4)
+    model = sgd_mf.SGDMF(session, cfg)
+    state = model.prepare(rows, cols, vals, 96, 80)
+    w_f, h_f, rmse, tuner = model.fit_adaptive(state)
+    assert rmse.shape == (16,)
+    # every candidate was measured during the sweep, then a choice stuck
+    assert set(tuner.times) == {1, 2, 4}
+    assert tuner.chosen in (1, 2, 4)
+    # convergence unhurt by the tuning epochs
+    assert rmse[-1] < 0.3 * rmse[0]
+    assert sgd_mf.numpy_rmse(w_f, h_f, rows, cols, vals) < 0.15
+
+
 def test_sgd_mf_two_slice_pipeline_converges(session):
     """numModelSlices=2 parity: double-buffered rotation (dymoro pipeline)
     converges like the single-slice schedule."""
