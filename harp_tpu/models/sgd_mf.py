@@ -198,7 +198,7 @@ class SGDMF:
         self.session = session
         self.config = config
         self._compiled = {}       # layout/shape key -> compiled SPMD program
-        self._warm: set = set()   # keys pre-compiled via AOT lower (fit_adaptive)
+        self._warm: dict = {}     # key -> AOT-compiled executable (fit_adaptive)
         self.last_layout_stats: dict = {}
 
     # -- schedule (shared by both layouts) ----------------------------------- #
@@ -627,10 +627,17 @@ class SGDMF:
         for _ in range(epochs):
             nmb = tuner.next_budget()
             key = self._program(layout, nmb, 1, geom)
-            fn = self._compiled[key]
             if key not in self._warm:
-                fn.lower(*data, w_cur, h_cur).compile()  # keep compile untimed
-                self._warm.add(key)
+                # AOT-compile outside the timed region and call the compiled
+                # executable directly — the jit wrapper's dispatch cache is NOT
+                # populated by lower().compile(), so calling the wrapper would
+                # re-compile inside the timing. One throwaway call (outputs
+                # discarded; the program is pure) absorbs first-execution
+                # costs (e.g. executable upload on remote platforms).
+                exe = self._compiled[key].lower(*data, w_cur, h_cur).compile()
+                np.asarray(exe(*data, w_cur, h_cur)[2])
+                self._warm[key] = exe
+            fn = self._warm[key]
             t0 = _time.perf_counter()
             w_cur, h_cur, r = fn(*data, w_cur, h_cur)
             r = np.asarray(r)        # fetch forces execution (remote platforms)

@@ -63,6 +63,30 @@ class EventQueue:
         return self._q.qsize()
 
 
+def _broadcast_payload(payload: Any, source: int) -> Any:
+    """Broadcast an arbitrary (picklable) payload from ``source`` to every
+    process: length round first, then the pickled bytes as a uint8 array —
+    ``broadcast_one_to_all`` itself only carries fixed-shape numerics. This is
+    the wire role of Harp's Writable encode/decode (resource/Writable.java:30)
+    for the host control plane."""
+    import pickle
+
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    is_source = jax.process_index() == source
+    data = (np.frombuffer(pickle.dumps(payload), np.uint8)
+            if is_source else np.zeros(0, np.uint8))
+    n = int(multihost_utils.broadcast_one_to_all(
+        np.int64(len(data)), is_source=is_source))
+    buf = np.zeros(n, np.uint8)
+    if is_source:
+        buf[:] = data[:n]
+    out = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
+    return pickle.loads(np.asarray(out).tobytes())
+
+
 class EventClient:
     """Send side (SyncClient.java:33). In a single-process session events are
     delivered straight to the local queue; multi-process sessions broadcast
@@ -88,10 +112,7 @@ class EventClient:
 
         src = 0 if source is None else source
         if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-
-            payload = multihost_utils.broadcast_one_to_all(
-                payload, is_source=jax.process_index() == src)
+            payload = _broadcast_payload(payload, src)
         else:
             src = self.worker_id
         self.queue.put(Event(EventType.COLLECTIVE, src, payload))
@@ -108,10 +129,7 @@ class EventClient:
 
         src = 0 if source is None else source
         if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-
-            payload = multihost_utils.broadcast_one_to_all(
-                payload, is_source=jax.process_index() == src)
+            payload = _broadcast_payload(payload, src)
             if jax.process_index() != dest:
                 return
         else:

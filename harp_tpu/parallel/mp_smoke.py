@@ -1,0 +1,183 @@
+"""Multi-process gang smoke routine — the runnable proof of the L3 bootstrap.
+
+Reference parity: Harp's de-facto integration harness was one JVM per worker
+launched over ssh by ``collective/Driver.java:93`` + ``depl/Depl.java:36``, with
+every collective class shipping a standalone ``main()`` (e.g.
+AllreduceCollective.java:53). This module is that harness TPU-native: run
+
+    python -m harp_tpu.parallel.mp_smoke <process_id> <num_processes> <port> \
+        [devices_per_process]
+
+once per process (the pytest parent and ``__graft_entry__.dryrun_multichip`` do
+the spawning). Each process joins the gang through
+``parallel.distributed.initialize`` (the YARN-AM/HDFS-rendezvous replacement),
+builds a HarpSession over the GLOBAL mesh, and exercises:
+
+* collective property checks vs numpy (allreduce, allgather, rotate) across the
+  process boundary,
+* one K-means iteration (the flagship workload) with replicated outputs compared
+  across processes,
+* the host event control plane's multi-process branches
+  (``EventClient.send_collective`` / ``send_message`` over
+  ``multihost_utils.broadcast_one_to_all``),
+* ``HarpSession.barrier()``'s multihost branch and a clean
+  ``distributed.shutdown`` (CollectiveMapper teardown :783-788).
+
+Prints ``MP_SMOKE OK p<i>/<n>`` on success; any failure raises.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def run(process_id: int, num_processes: int, port: int,
+        devices_per_process: int = 4) -> None:
+    # Virtual CPU devices must be requested before the backend initializes;
+    # the image's sitecustomize force-selects the TPU backend via jax.config,
+    # so override it back the same way (see tests/conftest.py).
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags +
+            f" --xla_force_host_platform_device_count={devices_per_process}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from harp_tpu.parallel import distributed
+
+    distributed.initialize(f"localhost:{port}", num_processes, process_id)
+    assert jax.process_count() == num_processes, jax.process_count()
+    world = num_processes * devices_per_process
+    assert len(jax.devices()) == world, (len(jax.devices()), world)
+    assert len(jax.local_devices()) == devices_per_process
+
+    from harp_tpu.collectives import lax_ops, table_ops
+    from harp_tpu.parallel.events import EventClient, EventQueue, EventType
+    from harp_tpu.session import HarpSession
+    from harp_tpu.table import Table
+
+    sess = HarpSession(num_workers=world)
+
+    # --- collective properties vs numpy across the process boundary --------- #
+    w = world
+    data = np.arange(w * 3, dtype=np.float32).reshape(w, 3) + 1.0
+
+    def allreduce_fn(x):
+        t = Table.local(x[0], num_workers=w)
+        return table_ops.allreduce(t).data
+
+    out = sess.run(allreduce_fn, sess.scatter(data[:, None, :]),
+                   in_specs=(sess.shard(),), out_specs=sess.replicate())
+    np.testing.assert_allclose(np.asarray(out)[0], data.sum(0), rtol=1e-6)
+
+    out = sess.run(lambda x: lax_ops.allgather(x[0])[None],
+                   sess.scatter(data[:, None, :]),
+                   in_specs=(sess.shard(),), out_specs=sess.replicate())
+    np.testing.assert_allclose(np.asarray(out)[0], data, rtol=1e-6)
+
+    # rotate: sharded output — check only this process's addressable shards
+    rot = sess.run(lambda x: lax_ops.rotate(x, 1),
+                   sess.scatter(data), in_specs=(sess.shard(),),
+                   out_specs=sess.shard())
+    for shard in rot.addressable_shards:
+        wid = shard.index[0].start
+        np.testing.assert_allclose(
+            np.asarray(shard.data)[0], data[(wid - 1) % w], rtol=1e-6)
+
+    # --- one K-means iteration (flagship) ------------------------------------ #
+    from harp_tpu.io import datagen
+    from harp_tpu.models import kmeans as km
+
+    pts = datagen.dense_points(world * 16, 8, seed=0, num_clusters=4)
+    cen0 = datagen.initial_centroids(pts, 4, seed=1)
+    model = km.KMeans(sess, km.KMeansConfig(4, 8, iterations=1))
+    cen, cost = model.fit(pts, cen0)
+    cen = np.asarray(cen)
+    assert np.all(np.isfinite(cen))
+    # replicated outputs must agree bit-for-bit across processes
+    from jax.experimental import multihost_utils
+
+    cen0_proc = multihost_utils.broadcast_one_to_all(
+        cen, is_source=jax.process_index() == 0)
+    np.testing.assert_array_equal(cen, cen0_proc)
+
+    # --- host event control plane (multi-process branches) ------------------- #
+    q = EventQueue()
+    client = EventClient(q, worker_id=process_id)
+    client.send_collective({"msg": "hello-gang", "from": 0}, source=0)
+    ev = q.get()
+    assert ev is not None and ev.type is EventType.COLLECTIVE
+    assert ev.payload["msg"] == "hello-gang"
+
+    client.send_message(dest=1, payload="direct", source=0)
+    ev = q.get()
+    if process_id == 1:
+        assert ev is not None and ev.type is EventType.MESSAGE
+        assert ev.payload == "direct"
+    else:
+        assert ev is None
+
+    # --- barrier + teardown --------------------------------------------------- #
+    sess.barrier()          # multihost branch: sync_global_devices
+    distributed.shutdown()
+    print(f"MP_SMOKE OK p{process_id}/{num_processes}", flush=True)
+
+
+def spawn_gang(num_processes: int = 2, devices_per_process: int = 4,
+               timeout: float = 240.0, repo_root: str | None = None
+               ) -> list:
+    """Spawn the gang from a parent process and reap it, killing every child on
+    any failure (the one shared implementation of the Driver.java-style
+    launcher; used by tests/test_multiprocess.py and __graft_entry__).
+
+    Returns each child's combined output; raises AssertionError/RuntimeError on
+    failure."""
+    import socket
+    import subprocess
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS":
+           f"--xla_force_host_platform_device_count={devices_per_process}"}
+    root = repo_root or os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "harp_tpu.parallel.mp_smoke",
+         str(i), str(num_processes), str(port), str(devices_per_process)],
+        cwd=root, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True) for i in range(num_processes)]
+    outs = []
+    try:
+        for i, p in enumerate(procs):
+            try:
+                out, _ = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                raise RuntimeError(
+                    f"mp_smoke process {i} timed out after {timeout}s")
+            outs.append(out)
+            assert p.returncode == 0, f"mp_smoke process {i} failed:\n{out}"
+            assert f"MP_SMOKE OK p{i}/{num_processes}" in out, out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return outs
+
+
+def main(argv=None) -> None:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) < 3:
+        raise SystemExit(__doc__)
+    run(int(argv[0]), int(argv[1]), int(argv[2]),
+        int(argv[3]) if len(argv) > 3 else 4)
+
+
+if __name__ == "__main__":
+    main()
