@@ -1,23 +1,38 @@
 """Subgraph counting via color coding — the SAHAD/Fascia workload.
 
 Reference parity: ml/java sahad/rotation{,2,3} (color-coding tree counting via
-rotation of vertex tables — 3 generations) and subgraph/ (Fascia-style, 5,102
-LoC), plus experimental daal_subgraph.
+rotation of vertex tables — 3 generations; sub-template matching at
+SCCollectiveMapper.java:217-347) and subgraph/ (Fascia-style, 5,102 LoC), plus
+experimental daal_subgraph.
 
-TPU-native: color coding for tree templates. Each trial assigns every vertex a
-random color of k; the dynamic program counts colorful embeddings bottom-up over
-the template's tree decomposition. For path templates (the SAHAD demo shapes)
-the DP state per vertex is a (2^k,) color-set vector and each DP level is a
-sparse matrix-vector product over the adjacency — expressed as ``segment_sum``
-over the edge list, sharded by source vertex and psum'd. The count estimate is
-unbiased after dividing by the colorful probability k!/k^k; trials vmap.
+TPU-native: color coding for ARBITRARY tree templates (k ≤ 7 vertices). Each
+trial assigns every vertex a random color of k; a dynamic program over the
+template's **sub-template decomposition** (the reference's SAHAD partitioning:
+peel one child subtree at a time off a rooted template) counts colorful
+homomorphisms bottom-up:
+
+    cnt_τ[v, S] = # colorful homs of sub-template τ rooted at graph vertex v
+                  using exactly the color set S (|S| = |τ|)
+
+* leaf:      cnt[v, S] = [S == {color(v)}]
+* attach c:  cnt_{τ'+c}[v, S] = Σ_{S1 ⊎ S2 = S} cnt_{τ'}[v, S1] · (A·cnt_c)[v, S2]
+
+The neighbor aggregation ``A·cnt`` is a push + ``segment_sum`` over this
+worker's edge shard followed by a ``psum`` (the same substrate as the rotation
+generations in sahad); the disjoint-union combine is a subset convolution
+evaluated as a dense pair-product × one-hot matmul (the pair list is tiny:
+≤ a few hundred for k ≤ 7 — MXU-friendly, no sparse control flow). Colorful ⇒
+all template vertices get distinct colors ⇒ the homomorphism is injective, so
+``Σ_v cnt_root[v, full] = #occurrences × aut(T)``; dividing by the tree
+automorphism count and the colorful probability k!/k^k gives an unbiased
+occurrence estimate, averaged over trials (vmapped).
 """
 
 from __future__ import annotations
 
 import dataclasses
 from math import factorial
-from typing import Tuple
+from typing import Dict, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -26,102 +41,293 @@ import numpy as np
 from harp_tpu.parallel.mesh import WORKERS
 from harp_tpu.session import HarpSession
 
+MAX_TEMPLATE = 7    # 2^k DP columns; 128 keeps the tables lane-aligned
+
+
+# --------------------------------------------------------------------------- #
+# Template analysis (host)
+# --------------------------------------------------------------------------- #
+
+class TreeTemplate:
+    """A tree template: vertices 0..k-1, undirected edges, rooted at 0.
+
+    Computes the SAHAD-style decomposition plan (post-order child attachment)
+    and the automorphism count used to convert homomorphism counts into
+    occurrence counts (SCCollectiveMapper.java:250 whole-template aggregation
+    divides the same way)."""
+
+    def __init__(self, edges: Sequence[Tuple[int, int]]):
+        self.edges = [(int(a), int(b)) for a, b in edges]
+        self.k = len(self.edges) + 1
+        if self.k > MAX_TEMPLATE:
+            raise ValueError(f"template must have at most {MAX_TEMPLATE} vertices")
+        adj: Dict[int, List[int]] = {v: [] for v in range(self.k)}
+        seen = set()
+        for a, b in self.edges:
+            if not (0 <= a < self.k and 0 <= b < self.k) or a == b:
+                raise ValueError(f"bad edge ({a},{b}) for k={self.k}")
+            key = (min(a, b), max(a, b))
+            if key in seen:
+                raise ValueError(f"duplicate edge {key}")
+            seen.add(key)
+            adj[a].append(b)
+            adj[b].append(a)
+        self.adj = adj
+        # connectivity check (k-1 edges + connected == tree)
+        stack, reach = [0], {0}
+        while stack:
+            v = stack.pop()
+            for u in adj[v]:
+                if u not in reach:
+                    reach.add(u)
+                    stack.append(u)
+        if len(reach) != self.k:
+            raise ValueError("template edges do not form a connected tree")
+        # rooted structure at 0
+        self.parent = {0: -1}
+        self.children: Dict[int, List[int]] = {v: [] for v in range(self.k)}
+        order = [0]
+        for v in order:
+            for u in adj[v]:
+                if u != self.parent[v]:
+                    self.parent[u] = v
+                    self.children[v].append(u)
+                    order.append(u)
+        self.postorder = order[::-1]
+        self.subtree_size = {v: 1 for v in range(self.k)}
+        for v in self.postorder:
+            for c in self.children[v]:
+                self.subtree_size[v] += self.subtree_size[c]
+
+    # -- automorphisms ------------------------------------------------------- #
+
+    def _rooted_code_aut(self, v: int, parent: int) -> Tuple[str, int]:
+        """AHU canonical code + automorphism count of the subtree rooted at v."""
+        items = sorted(self._rooted_code_aut(c, v)
+                       for c in self.adj[v] if c != parent)
+        aut = 1
+        run = 0
+        for i, (code, a) in enumerate(items):
+            aut *= a
+            if i > 0 and code == items[i - 1][0]:
+                run += 1
+            else:
+                run = 0
+            aut *= (run + 1)   # multiply in the factorial of each equal-run
+        return "(" + "".join(c for c, _ in items) + ")", aut
+
+    def automorphisms(self) -> int:
+        """|Aut(T)| via centroid-rooted AHU canonical forms."""
+        if self.k == 1:
+            return 1
+        # centroid(s): vertices whose heaviest component after removal has
+        # <= k/2 vertices (the components of T - v are v's "down" subtrees)
+        centroids = [v for v in range(self.k)
+                     if max(self._down_size(u, v)
+                            for u in self.adj[v]) <= self.k // 2]
+        if len(centroids) == 1:
+            return self._rooted_code_aut(centroids[0], -1)[1]
+        a, b = centroids
+        code_a, aut_a = self._rooted_code_aut(a, b)
+        code_b, aut_b = self._rooted_code_aut(b, a)
+        return aut_a * aut_b * (2 if code_a == code_b else 1)
+
+    def _down_size(self, u: int, parent: int) -> int:
+        total = 1
+        for w in self.adj[u]:
+            if w != parent:
+                total += self._down_size(w, u)
+        return total
+
+    # -- subset-convolution pair tables -------------------------------------- #
+
+    def conv_tables(self) -> Dict[Tuple[int, int], Tuple[np.ndarray, np.ndarray,
+                                                         np.ndarray]]:
+        """For each (size_a, size_b) child attachment in the decomposition,
+        the disjoint pair list (s1, s2) and the one-hot scatter matrix to
+        s1 | s2 — precomputed on the host, consumed as dense matmuls."""
+        k = self.k
+        n_sets = 1 << k
+        pop = np.array([bin(s).count("1") for s in range(n_sets)])
+        needed = set()
+        for v in self.postorder:
+            acc = 1
+            for c in self.children[v]:
+                needed.add((acc, self.subtree_size[c]))
+                acc += self.subtree_size[c]
+        tables = {}
+        for (a, b) in needed:
+            s1s, s2s = [], []
+            for s1 in range(n_sets):
+                if pop[s1] != a:
+                    continue
+                for s2 in range(n_sets):
+                    if pop[s2] == b and not (s1 & s2):
+                        s1s.append(s1)
+                        s2s.append(s2)
+            s1a = np.asarray(s1s, np.int32)
+            s2a = np.asarray(s2s, np.int32)
+            scatter = np.zeros((len(s1a), n_sets), np.float32)
+            scatter[np.arange(len(s1a)), s1a | s2a] = 1.0
+            tables[(a, b)] = (s1a, s2a, scatter)
+        return tables
+
 
 @dataclasses.dataclass(frozen=True)
 class SubgraphConfig:
-    template_size: int = 3       # path template with k vertices (k <= 5)
+    template_size: int = 3       # used by count_paths (path template)
     trials: int = 8              # color-coding repetitions
 
 
-def _path_count_one_trial(nbr, mask, colors, v_pad: int, num_vertices: int,
-                          k: int, axis_name: str = WORKERS):
-    """Count colorful k-paths for one coloring. DP over path prefixes:
+# --------------------------------------------------------------------------- #
+# Device DP
+# --------------------------------------------------------------------------- #
 
-    dp[t][v][S] = # walks of length t ending at v using color set S (|S|=t+1).
-    Colorful-path DP guarantees vertex-distinctness within a path because
-    repeated vertices would repeat a color. nbr/mask: this worker's padded
-    out-neighbor lists (V_local, M) (undirected graphs list both directions).
-    """
+def _tree_count_one_trial(template: TreeTemplate, conv, nbr, mask, colors,
+                          v_pad: int, num_vertices: int,
+                          axis_name: str = WORKERS):
+    """Count colorful homs of the template for one coloring (see module doc)."""
+    k = template.k
     n_sets = 1 << k
-    pop = jnp.asarray([bin(s).count("1") for s in range(n_sets)])
     color_bit = 1 << colors                                  # (V,) replicated
-
-    # dp over FULL vertex set (replicated) so neighbor gathers stay local;
-    # padding vertices (id >= num_vertices) hold no dp mass
-    dp = (jax.nn.one_hot(color_bit, n_sets, dtype=jnp.float32)
-          * (jnp.arange(v_pad) < num_vertices)[:, None])     # (V, 2^k)
+    valid = (jnp.arange(v_pad) < num_vertices)[:, None]
+    leaf = jax.nn.one_hot(color_bit, n_sets, dtype=jnp.float32) * valid
 
     wid = jax.lax.axis_index(axis_name)
     v_local = nbr.shape[0]
 
-    def level(dp_full, _):
-        # new_dp[v][S] = Σ_{u ∈ N(v)} dp[u][S − color(v)]  if color(v) ∈ S
-        # computed from the source side: each u pushes dp[u] to its neighbors.
-        push = dp_full[wid * v_local + jnp.arange(v_local)]  # (V_local, 2^k)
+    def neighbor_sum(table):
+        """(A · table)[v] = Σ_{u ∈ N(v)} table[u] — push from this worker's
+        source shard, segment-sum into destinations, psum across workers."""
+        push = table[wid * v_local + jnp.arange(v_local)]    # (V_local, 2^k)
         contrib = push[:, None, :] * mask[..., None]         # (V_local, M, 2^k)
         gathered = jax.ops.segment_sum(
             contrib.reshape(-1, n_sets), nbr.reshape(-1), num_segments=v_pad)
-        gathered = jax.lax.psum(gathered, axis_name)         # (V, 2^k)
-        # shift into sets that include the destination's own color
-        s_ids = jnp.arange(n_sets)
-        has_c = (s_ids[None, :] & color_bit[:, None]) > 0    # (V, 2^k)
-        prev_set = s_ids[None, :] ^ color_bit[:, None]       # S − color(v)
-        new_dp = jnp.where(has_c,
-                           jnp.take_along_axis(gathered, prev_set, axis=1),
-                           0.0)
-        return new_dp, None
+        return jax.lax.psum(gathered, axis_name)             # (V, 2^k)
 
-    dp, _ = jax.lax.scan(level, dp, None, length=k - 1)
-    full_set_counts = dp[:, n_sets - 1]                      # |S| = k ending at v
-    # each path counted twice (once per endpoint direction)
-    raw = jnp.sum(full_set_counts) / 2.0
+    # bottom-up over the decomposition: tables[t] = cnt for subtree rooted at t
+    tables: Dict[int, jax.Array] = {}
+    for t in template.postorder:
+        cnt = leaf
+        acc = 1
+        for c in template.children[t]:
+            nb = neighbor_sum(tables.pop(c))
+            s1a, s2a, scatter = conv[(acc, template.subtree_size[c])]
+            pair = cnt[:, s1a] * nb[:, s2a]                  # (V, P)
+            cnt = pair @ scatter                             # subset convolution
+            acc += template.subtree_size[c]
+        tables[t] = cnt
+
+    root = tables[0]
+    raw = jnp.sum(root[:, n_sets - 1]) / float(template.automorphisms())
     p_colorful = factorial(k) / float(k ** k)
     return raw / p_colorful
 
 
-def _count(nbr, mask, keys, v_pad: int, num_vertices: int,
-           cfg: SubgraphConfig, axis_name: str = WORKERS):
+def _count(template, conv, nbr, mask, keys, v_pad: int, num_vertices: int,
+           axis_name: str = WORKERS):
     def trial(key):
-        colors = jax.random.randint(key, (v_pad,), 0, cfg.template_size)
-        return _path_count_one_trial(nbr, mask, colors, v_pad, num_vertices,
-                                     cfg.template_size, axis_name)
+        colors = jax.random.randint(key, (v_pad,), 0, template.k)
+        return _tree_count_one_trial(template, conv, nbr, mask, colors,
+                                     v_pad, num_vertices, axis_name)
 
     counts = jax.vmap(trial)(keys)
     return jnp.mean(counts), counts
 
 
 class SubgraphCounter:
-    """Distributed color-coding path counting (sahad parity)."""
+    """Distributed color-coding tree counting (sahad/Fascia parity)."""
 
     def __init__(self, session: HarpSession, config: SubgraphConfig):
         self.session = session
         self.config = config
         self._fns = {}
 
-    def count_paths(self, src: np.ndarray, dst: np.ndarray, num_vertices: int,
-                    seed: int = 0) -> Tuple[float, np.ndarray]:
-        """Estimate the number of simple paths with ``template_size`` vertices
-        in the undirected graph given by the edge list (each undirected edge
-        listed once; both directions are added internally).
-
-        Returns (estimate, per-trial estimates).
-        """
+    def count_template(self, template_edges: Sequence[Tuple[int, int]],
+                       src: np.ndarray, dst: np.ndarray, num_vertices: int,
+                       seed: int = 0) -> Tuple[float, np.ndarray]:
+        """Estimate the number of occurrences of the tree template (vertex set
+        + edge structure, unlabeled) in the undirected graph given by the edge
+        list (each undirected edge listed once; both directions are added
+        internally). Returns (estimate, per-trial estimates)."""
         from harp_tpu.models.pagerank import pad_out_edges
 
         sess, cfg = self.session, self.config
-        if cfg.template_size > 5:
-            raise ValueError("template_size > 5 not supported (2^k DP state)")
+        template = TreeTemplate(template_edges)
+        # occurrence counting is defined on SIMPLE graphs: drop self-loops and
+        # duplicate undirected edges (a multi-edge would be counted per copy by
+        # the homomorphism DP)
+        src = np.asarray(src, np.int64)
+        dst = np.asarray(dst, np.int64)
+        keep = src != dst
+        lo = np.minimum(src[keep], dst[keep])
+        hi = np.maximum(src[keep], dst[keep])
+        uniq = np.unique(lo * num_vertices + hi)
+        src = uniq // num_vertices
+        dst = uniq % num_vertices
         s2 = np.concatenate([src, dst])
         d2 = np.concatenate([dst, src])
         nbr, mask, _ = pad_out_edges(s2, d2, num_vertices, sess.num_workers)
         v_pad = nbr.shape[0]
         keys = jax.random.split(jax.random.PRNGKey(seed), cfg.trials)
-        key = (nbr.shape, num_vertices, cfg.trials, cfg.template_size)
-        if key not in self._fns:
-            self._fns[key] = sess.spmd(
-                lambda a, b, ks: _count(a, b, ks, v_pad, num_vertices, cfg),
+        cache_key = (tuple(sorted((min(a, b), max(a, b))
+                                  for a, b in template.edges)),
+                     nbr.shape, num_vertices, cfg.trials)
+        if cache_key not in self._fns:
+            conv = {kk: tuple(map(jnp.asarray, vv))
+                    for kk, vv in template.conv_tables().items()}
+            self._fns[cache_key] = sess.spmd(
+                lambda a, b, ks: _count(template, conv, a, b, ks, v_pad,
+                                        num_vertices),
                 in_specs=(sess.shard(), sess.shard(), sess.replicate()),
                 out_specs=(sess.replicate(), sess.replicate()))
-        est, trials = self._fns[key](sess.scatter(nbr), sess.scatter(mask),
-                                     keys)
+        est, trials = self._fns[cache_key](sess.scatter(nbr),
+                                           sess.scatter(mask), keys)
         return float(est), np.asarray(trials)
+
+    def count_paths(self, src: np.ndarray, dst: np.ndarray, num_vertices: int,
+                    seed: int = 0) -> Tuple[float, np.ndarray]:
+        """Estimate the number of simple paths with ``template_size`` vertices
+        (the SAHAD demo shapes) — a path template through the general tree DP."""
+        k = self.config.template_size
+        if k > 5:
+            raise ValueError("template_size > 5 not supported for count_paths")
+        path = [(i, i + 1) for i in range(k - 1)]
+        return self.count_template(path, src, dst, num_vertices, seed)
+
+
+def brute_force_tree_count(template_edges: Sequence[Tuple[int, int]],
+                           src: np.ndarray, dst: np.ndarray,
+                           num_vertices: int) -> int:
+    """Exact occurrence count by backtracking over injective homomorphisms,
+    divided by aut(T) — the test oracle for tiny graphs."""
+    template = TreeTemplate(template_edges)
+    adj: Dict[int, set] = {v: set() for v in range(num_vertices)}
+    for a, b in zip(src, dst):
+        if a != b:
+            adj[int(a)].add(int(b))
+            adj[int(b)].add(int(a))
+    order = [0]
+    for v in order:
+        for u in template.children[v]:
+            order.append(u)
+    homs = 0
+
+    def extend(pos, mapping):
+        nonlocal homs
+        if pos == len(order):
+            homs += 1
+            return
+        t = order[pos]
+        p = template.parent[t]
+        candidates = (adj[mapping[p]] if p >= 0 else range(num_vertices))
+        used = set(mapping.values())
+        for g in candidates:
+            if g in used:
+                continue
+            mapping[t] = g
+            extend(pos + 1, mapping)
+            del mapping[t]
+
+    extend(0, {})
+    return homs // template.automorphisms()
