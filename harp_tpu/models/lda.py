@@ -8,7 +8,11 @@ asynchronous rotation") and contrib/lda (CVB0).
 TPU-native reformulation (SURVEY §7 "hard parts" — async semantics under SPMD):
 
 * Docs are sharded over workers; the word-topic count matrix is split into W
-  vocab blocks that ring-rotate (``ppermute``) — Harp's Rotator schedule.
+  vocab blocks that ring-rotate (``ppermute``) — Harp's Rotator schedule. Words
+  are dealt to blocks by **balanced (serpentine-LPT) corpus frequency** so a
+  Zipf head word cannot blow up the per-(doc, block) token padding (the
+  reference's clueweb vocabulary is exactly Zipf; set ``balance=False`` for the
+  round-1 contiguous id ranges).
 * Strictly sequential per-token Gibbs is hostile to SPMD, so sampling is
   **blocked**: during a hop, every token of the resident vocab block draws its
   topic from the CURRENT counts in parallel; count deltas are applied after the
@@ -19,15 +23,24 @@ TPU-native reformulation (SURVEY §7 "hard parts" — async semantics under SPMD
 * Topic totals n_k are refreshed by psum once per hop — bounded staleness,
   replacing Harp's asynchronously drifting totals.
 
-Likelihood monitor: the model's per-epoch joint log-likelihood terms that depend
-on counts (word-topic part), allreduced — matching the reference's
-printLogLikelihood role rather than its exact formula.
+Likelihood monitor: the REFERENCE formula, exactly (CalcLikelihoodTask.run:56 +
+the topic-sum completion in printLikelihood, LDAMPCollectiveMapper.java:731-748
+— MALLET's word-topic model-likelihood part):
+
+    LL = Σ_{w,k: n_wk>0} [lgamma(β + n_wk) − lgamma(β)]
+         − Σ_k lgamma(Vβ + n_k) + K·lgamma(Vβ)
+
+allreduced per epoch, so BASELINE's time-to-likelihood rows are directly
+measurable. :func:`full_model_log_likelihood` additionally adds the doc-topic
+term of the full MALLET formula (the reference omits it) for model comparison,
+and :func:`sequential_cgs_reference` is the single-device token-sequential CGS
+oracle the convergence-parity test measures against.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -48,33 +61,48 @@ class LDAConfig:
     beta: float = 0.01
     epochs: int = 20
     method: str = "cgs"         # "cgs" (ml/java lda) or "cvb0" (contrib/lda)
+    balance: bool = True        # serpentine-LPT word→block assignment
+    minibatches_per_hop: int = 4  # sequential doc-group sub-steps per hop:
+    #   fully-parallel draws let every token of a word resample against the
+    #   SAME stale word-topic row each round (a word's tokens can never
+    #   coordinate on a topic), which parks the chain at a diffuse fixed
+    #   point; refreshing counts between doc-groups restores near-sequential
+    #   mixing (the analog of the reference's per-thread token batches under
+    #   the dymoro timer, Scheduler.java:110-121)
 
 
-def bucketize_tokens(docs: np.ndarray, num_blocks: int, vpb: int
+def bucketize_tokens(docs: np.ndarray, num_blocks: int, vpb: int,
+                     word_block: Optional[np.ndarray] = None,
+                     word_slot: Optional[np.ndarray] = None,
                      ) -> Tuple[np.ndarray, np.ndarray, int]:
     """Host-side layout: (D, L) tokens → (D, W, Lb) grouped by home vocab block.
 
     Each hop then processes exactly the resident block's tokens (padded to the
     max per-(doc, block) count Lb) instead of sampling every token every hop.
+    The stored token ids are block-LOCAL slots. ``word_block``/``word_slot``
+    are optional id maps (see sgd_mf.serpentine_assign); default contiguous.
     """
     d, l = docs.shape
     rows = np.arange(d)[:, None]
-    block = np.minimum(docs // vpb, num_blocks - 1)
+    if word_block is None:
+        block = np.minimum(docs // vpb, num_blocks - 1)
+        slot = docs - block * vpb
+    else:
+        block = word_block[docs]
+        slot = word_slot[docs]
     counts = np.zeros((d, num_blocks), np.int64)
     np.add.at(counts, (rows, block), 1)
     lb = max(int(counts.max()), 1)
-    # padding slots hold each block's first word id (in-range for w_local);
-    # mask zeroes their effect on counts and sampling
-    base = (np.arange(num_blocks) * vpb).astype(docs.dtype)
-    docs_b = np.broadcast_to(base[None, :, None], (d, num_blocks, lb)).copy()
+    # padding slots hold local id 0 (in-range); mask zeroes their effect
+    docs_b = np.zeros((d, num_blocks, lb), docs.dtype)
     mask_b = np.zeros((d, num_blocks, lb), np.float32)
     order = np.argsort(block, axis=1, kind="stable")
     sorted_block = np.take_along_axis(block, order, axis=1)
-    sorted_docs = np.take_along_axis(docs, order, axis=1)
+    sorted_slot = np.take_along_axis(slot, order, axis=1)
     bucket_starts = np.concatenate(
         [np.zeros((d, 1), np.int64), np.cumsum(counts, axis=1)[:, :-1]], axis=1)
     pos = np.arange(l)[None, :] - bucket_starts[rows, sorted_block]
-    docs_b[rows, sorted_block, pos] = sorted_docs
+    docs_b[rows, sorted_block, pos] = sorted_slot
     mask_b[rows, sorted_block, pos] = 1.0
     return docs_b, mask_b, lb
 
@@ -89,62 +117,90 @@ class LDA:
         self.session = session
         self.config = config
         self._fns = {}
+        self.last_layout_stats: dict = {}
 
-    def _build(self, w: int, v_pad: int, lb: int):
+    def _build(self, w: int, v_pad: int, lb: int, d_local: int):
         cfg = self.config
         k = cfg.num_topics
         vpb = v_pad // w                      # vocab per block
+        # sequential doc-group sub-steps per hop (largest divisor that fits)
+        nmb = max(g for g in range(1, min(cfg.minibatches_per_hop,
+                                          d_local) + 1) if d_local % g == 0)
+        dg = d_local // nmb
 
         def fit_fn(docs_b, mask_b, z0, wt_block0, seed):
             # docs_b/mask_b/z0: (D_local, W, Lb) — tokens pre-bucketed by home
-            # vocab block (host-side, bucketize_tokens), so each hop touches
-            # only the resident block's tokens instead of sampling all tokens
-            # and discarding (w-1)/w of the draws.
-            def hop_body(carry, wt_block, t):
-                doc_topic, z, topic_tot, key = carry
-                wid = lax_ops.worker_id()
-                src = (wid - t) % w           # home block of resident slice
-                docs_s = jnp.take(docs_b, src, axis=1)        # (D, Lb)
-                mask_s = jnp.take(mask_b, src, axis=1)
-                w_local = docs_s - src * vpb
+            # vocab block (host-side, bucketize_tokens; ids are block-local
+            # slots), so each hop touches only the resident block's tokens
+            # instead of sampling all tokens and discarding (w-1)/w of draws.
+            soft = cfg.method == "cvb0"
 
-                # blocked update: resident-block tokens update from current
-                # counts: p(z=k) ∝ (n_dk−cur+α)(n_wk−cur+β)/(n_k−cur+Vβ)
-                if cfg.method == "cvb0":
-                    # z carries SOFT assignments gamma (D, W, Lb, K)
-                    cur = jnp.take(z, src, axis=1) * mask_s[..., None]
+            def group_update(wt_block, tt_local, key, wl_g, ms_g, zs_g, dt_g):
+                """Resample one doc-group's resident-block tokens from the
+                CURRENT counts: p(z=k) ∝ (n_dk−cur+α)(n_wk−cur+β)/(n_k−cur+Vβ)."""
+                if soft:
+                    cur = zs_g * ms_g[..., None]              # (dg, Lb, K)
                 else:
-                    z_s = jnp.take(z, src, axis=1)
-                    cur = (jax.nn.one_hot(z_s, k, dtype=jnp.float32)
-                           * mask_s[..., None])               # (D, Lb, K)
-                nd = doc_topic[:, None, :] - cur              # exclude self
-                nw = wt_block[w_local] - cur
-                nk = topic_tot[None, None, :] - cur
+                    cur = (jax.nn.one_hot(zs_g, k, dtype=jnp.float32)
+                           * ms_g[..., None])
+                nd = dt_g[:, None, :] - cur                   # exclude self
+                nw = wt_block[wl_g] - cur
+                nk = tt_local[None, None, :] - cur
                 logits = (jnp.log(jnp.maximum(nd + cfg.alpha, 1e-10))
                           + jnp.log(jnp.maximum(nw + cfg.beta, 1e-10))
                           - jnp.log(jnp.maximum(nk + cfg.vocab * cfg.beta,
                                                 1e-10)))
-                if cfg.method == "cvb0":
+                if soft:
                     # CVB0 (contrib/lda CVB0 LdaMapCollective): deterministic
                     # mean-field update — soft assignment = normalized
                     # probabilities instead of a sample
-                    new = jax.nn.softmax(logits, axis=-1) * mask_s[..., None]
-                    z = jnp.where(
-                        (jnp.arange(w) == src)[None, :, None, None],
-                        new[:, None, :, :], z)
+                    zs_new = jax.nn.softmax(logits, axis=-1) * ms_g[..., None]
+                    new = zs_new
                 else:
                     key, sub = jax.random.split(key)
-                    z_new = jax.random.categorical(sub, logits, axis=-1)
-                    new = (jax.nn.one_hot(z_new, k, dtype=jnp.float32)
-                           * mask_s[..., None])
-                    z = jnp.where((jnp.arange(w) == src)[None, :, None],
-                                  z_new[:, None, :], z)
-                delta = new - cur                             # (D, Lb, K)
-                doc_topic = doc_topic + delta.sum(axis=1)
+                    zs_new = jax.random.categorical(sub, logits, axis=-1)
+                    new = (jax.nn.one_hot(zs_new, k, dtype=jnp.float32)
+                           * ms_g[..., None])
+                delta = new - cur                             # (dg, Lb, K)
                 wt_block = wt_block + jax.ops.segment_sum(
-                    delta.reshape(-1, k), w_local.reshape(-1), num_segments=vpb)
-                # bounded-staleness topic totals: refresh by psum of deltas
-                topic_tot = topic_tot + jax.lax.psum(delta.sum(axis=(0, 1)),
+                    delta.reshape(-1, k), wl_g.reshape(-1), num_segments=vpb)
+                d_k = delta.sum(axis=(0, 1))
+                return (wt_block, tt_local + d_k, d_k, key,
+                        zs_new, dt_g + delta.sum(axis=1))
+
+            def hop_body(carry, wt_block, t):
+                doc_topic, z, topic_tot, key = carry
+                wid = lax_ops.worker_id()
+                src = (wid - t) % w           # home block of resident slice
+                w_local = jnp.take(docs_b, src, axis=1)       # (D, Lb) slots
+                mask_s = jnp.take(mask_b, src, axis=1)
+                z_s = jnp.take(z, src, axis=1)
+
+                def grp(carry2, xs):
+                    wt_b, tt_loc, hop_d, key = carry2
+                    wl_g, ms_g, zs_g, dt_g = xs
+                    wt_b, tt_loc, d_k, key, zs_new, dt_new = group_update(
+                        wt_b, tt_loc, key, wl_g, ms_g, zs_g, dt_g)
+                    return (wt_b, tt_loc, hop_d + d_k, key), (zs_new, dt_new)
+
+                z_shape = ((nmb, dg, lb, k) if soft else (nmb, dg, lb))
+                (wt_block, _, hop_delta, key), (zs_new, dt_new) = jax.lax.scan(
+                    grp,
+                    (wt_block, topic_tot, jnp.zeros(k), key),
+                    (w_local.reshape(nmb, dg, lb),
+                     mask_s.reshape(nmb, dg, lb),
+                     z_s.reshape(z_shape),
+                     doc_topic.reshape(nmb, dg, k)))
+                doc_topic = dt_new.reshape(d_local, k)
+                zs_new = zs_new.reshape(z_s.shape)
+                if soft:
+                    z = jnp.where((jnp.arange(w) == src)[None, :, None, None],
+                                  zs_new[:, None, :, :], z)
+                else:
+                    z = jnp.where((jnp.arange(w) == src)[None, :, None],
+                                  zs_new[:, None, :], z)
+                # bounded-staleness topic totals: refresh by psum once per hop
+                topic_tot = topic_tot + jax.lax.psum(hop_delta,
                                                      lax_ops.WORKERS)
                 return (doc_topic, z, topic_tot, key), wt_block
 
@@ -157,17 +213,24 @@ class LDA:
                              * mask_b[..., None]).sum(axis=(1, 2))
             topic_tot = jax.lax.psum(doc_topic.sum(axis=0), lax_ops.WORKERS)
 
+            lgamma = jax.scipy.special.gammaln
+            v_beta = cfg.vocab * cfg.beta
+
             def epoch(state, _):
                 doc_topic, z, topic_tot, wt, key = state
                 (doc_topic, z, topic_tot, key), wt = rotation.rotate_scan(
                     hop_body, (doc_topic, z, topic_tot, key), wt, w)
-                # log-likelihood proxy: Σ lgamma(n_wk+β) − Σ lgamma(n_k+Vβ)
+                # REFERENCE log-likelihood (CalcLikelihoodTask.run:56 +
+                # printLikelihood:731-748): nonzero word-topic cells only,
+                # then the topic-sum completion terms
+                nz = wt > 0.5
                 ll_w = jax.lax.psum(
-                    jnp.sum(jax.scipy.special.gammaln(wt + cfg.beta)),
+                    jnp.sum(jnp.where(nz, lgamma(wt + cfg.beta)
+                                      - lgamma(cfg.beta), 0.0)),
                     lax_ops.WORKERS)
-                ll_k = jnp.sum(jax.scipy.special.gammaln(
-                    topic_tot + cfg.vocab * cfg.beta))
-                return (doc_topic, z, topic_tot, wt, key), ll_w - ll_k
+                ll = (ll_w - jnp.sum(lgamma(topic_tot + v_beta))
+                      + k * lgamma(v_beta))
+                return (doc_topic, z, topic_tot, wt, key), ll
 
             (doc_topic, z, topic_tot, wt, key), ll = jax.lax.scan(
                 epoch, (doc_topic, z0, topic_tot, wt_block0, key), None,
@@ -187,23 +250,54 @@ class LDA:
             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Train on a (num_docs, doc_len) token matrix.
 
-        Returns (doc_topic (D, K), word_topic (V, K), log-likelihood per epoch).
+        Returns (doc_topic (D, K), word_topic (V, K), log-likelihood per epoch
+        in the reference formula).
         """
         sess, cfg = self.session, self.config
         w = sess.num_workers
-        v_pad = -(-cfg.vocab // w) * w
+        vpb = -(-cfg.vocab // w)
+        v_pad = vpb * w
         num_docs = docs.shape[0]
         if num_docs % w:
             raise ValueError(f"num_docs {num_docs} must divide over {w} workers")
+        if docs.size and (docs.min() < 0 or docs.max() >= cfg.vocab):
+            raise ValueError(
+                f"token ids must be in [0, {cfg.vocab}); got "
+                f"[{docs.min()}, {docs.max()}]")
 
-        docs_b, mask_b, lb = bucketize_tokens(docs, w, v_pad // w)
+        from harp_tpu.models.sgd_mf import identity_assign, serpentine_assign
+
+        if cfg.balance:
+            word_block, word_slot = serpentine_assign(
+                np.bincount(docs.reshape(-1), minlength=cfg.vocab), w)
+        else:
+            word_block, word_slot = identity_assign(cfg.vocab, w)
+
+        docs_b, mask_b, lb = bucketize_tokens(docs, w, vpb, word_block,
+                                              word_slot)
+        d_local = num_docs // w
+        nmb_eff = max(g for g in range(1, min(cfg.minibatches_per_hop,
+                                              d_local) + 1)
+                      if d_local % g == 0)
+        self.last_layout_stats = {
+            "padded": int(docs_b.size), "tokens": int(docs.size),
+            "overhead": docs_b.size / max(docs.size, 1),
+            # sub-steps actually used: largest divisor of docs-per-worker that
+            # fits the configured budget (prime d_local can degrade this to 1,
+            # which weakens mixing — check this field if convergence stalls)
+            "minibatches_per_hop": nmb_eff,
+        }
         rng = np.random.default_rng(seed)
         z0 = rng.integers(0, cfg.num_topics, docs_b.shape).astype(np.int32)
-        # initial word-topic counts, laid out as W stacked vocab blocks
-        wt = np.zeros((v_pad, cfg.num_topics), np.float32)
-        np.add.at(wt, docs_b.reshape(-1),
+        # initial word-topic counts, laid out as W stacked vocab blocks of
+        # block-local slots
+        wt = np.zeros((w, vpb, cfg.num_topics), np.float32)
+        blk = np.broadcast_to(np.arange(w)[None, :, None],
+                              docs_b.shape).reshape(-1)
+        np.add.at(wt, (blk, docs_b.reshape(-1)),
                   np.eye(cfg.num_topics, dtype=np.float32)[z0.reshape(-1)]
                   * mask_b.reshape(-1, 1))
+        wt = wt.reshape(v_pad, cfg.num_topics)
         if cfg.method == "cvb0":
             # soft assignments: one-hot init (same counts as the CGS init)
             z0 = (np.eye(cfg.num_topics, dtype=np.float32)[z0]
@@ -211,12 +305,96 @@ class LDA:
 
         key = (w, v_pad, lb, num_docs, cfg.method)
         if key not in self._fns:
-            self._fns[key] = self._build(w, v_pad, lb)
+            self._fns[key] = self._build(w, v_pad, lb, num_docs // w)
         doc_topic, wt_out, z, ll = self._fns[key](
             sess.scatter(jnp.asarray(docs_b, jnp.int32)),
             sess.scatter(jnp.asarray(mask_b, jnp.float32)),
             sess.scatter(jnp.asarray(z0)),
             sess.scatter(jnp.asarray(wt)),
             jnp.asarray(seed, jnp.int32))
-        return (np.asarray(doc_topic), np.asarray(wt_out)[: cfg.vocab],
-                np.asarray(ll))
+        # un-permute word rows back to original vocab ids
+        wt_out = np.asarray(wt_out)
+        wt_final = wt_out[word_block.astype(np.int64) * vpb + word_slot]
+        return np.asarray(doc_topic), wt_final, np.asarray(ll)
+
+
+# --------------------------------------------------------------------------- #
+# Oracles (host)
+# --------------------------------------------------------------------------- #
+
+def reference_log_likelihood(word_topic: np.ndarray, beta: float,
+                             vocab: int) -> float:
+    """The reference's likelihood formula on host counts (CalcLikelihoodTask +
+    printLikelihood completion) — for tests and offline evaluation."""
+    return _ref_ll_np(word_topic, beta, vocab)
+
+
+def _lgamma(x):
+    try:
+        from scipy.special import gammaln
+        return gammaln(x)
+    except Exception:
+        from math import lgamma
+        return np.vectorize(lgamma)(x)
+
+
+def _ref_ll_np(word_topic: np.ndarray, beta: float, vocab: int) -> float:
+    k = word_topic.shape[1]
+    nz = word_topic > 0.5
+    ll = float(np.sum(np.where(nz, _lgamma(word_topic + beta)
+                               - _lgamma(beta), 0.0)))
+    topic_tot = word_topic.sum(axis=0)
+    ll -= float(np.sum(_lgamma(topic_tot + vocab * beta)))
+    ll += k * float(_lgamma(np.asarray(vocab * beta)))
+    return ll
+
+
+def full_model_log_likelihood(doc_topic: np.ndarray, word_topic: np.ndarray,
+                              alpha: float, beta: float, vocab: int) -> float:
+    """Full MALLET model log-likelihood: the reference's word part plus the
+    doc-topic term it omits (ParallelTopicModel.modelLogLikelihood)."""
+    k = doc_topic.shape[1]
+    ll = _ref_ll_np(word_topic, beta, vocab)
+    nz = doc_topic > 0.5
+    ll += float(np.sum(np.where(nz, _lgamma(doc_topic + alpha)
+                                - _lgamma(alpha), 0.0)))
+    ll -= float(np.sum(_lgamma(doc_topic.sum(axis=1) + k * alpha)))
+    ll += doc_topic.shape[0] * float(_lgamma(np.asarray(k * alpha)))
+    return ll
+
+
+def sequential_cgs_reference(docs: np.ndarray, cfg: LDAConfig, seed: int = 0
+                             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Single-device token-sequential CGS — the convergence-parity oracle.
+
+    Returns (doc_topic, word_topic, per-epoch reference log-likelihood)."""
+    rng = np.random.default_rng(seed)
+    d, l = docs.shape
+    k, v = cfg.num_topics, cfg.vocab
+    z = rng.integers(0, k, (d, l))
+    ndk = np.zeros((d, k))
+    nwk = np.zeros((v, k))
+    nk = np.zeros(k)
+    for di in range(d):
+        for li in range(l):
+            t = z[di, li]
+            ndk[di, t] += 1
+            nwk[docs[di, li], t] += 1
+            nk[t] += 1
+    lls = []
+    for _ in range(cfg.epochs):
+        for di in range(d):
+            for li in range(l):
+                wi, t = docs[di, li], z[di, li]
+                ndk[di, t] -= 1
+                nwk[wi, t] -= 1
+                nk[t] -= 1
+                p = ((ndk[di] + cfg.alpha) * (nwk[wi] + cfg.beta)
+                     / (nk + v * cfg.beta))
+                t = rng.choice(k, p=p / p.sum())
+                z[di, li] = t
+                ndk[di, t] += 1
+                nwk[wi, t] += 1
+                nk[t] += 1
+        lls.append(_ref_ll_np(nwk, cfg.beta, v))
+    return ndk, nwk, np.asarray(lls)
