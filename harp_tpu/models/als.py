@@ -8,8 +8,17 @@ TPU-native: the factor matrices stay REPLICATED between half-iterations (they ar
 small: entities × rank); each half-iteration a worker solves the normal equations
 for its shard of users (then items) as one batched Cholesky solve on the MXU, and
 one all_gather re-replicates the updated factor — DAAL's step1-4 dance collapses
-to "batched local solve + allgather". Ragged observed-item lists become padded
-(entity, max_nnz) index/value buckets (SURVEY §7 sparse-data recipe).
+to "batched local solve + allgather".
+
+Sparse layout (SURVEY §7 recipe, skew-robust): ragged observed-entry lists become
+**capped chunks** — a row's entries split into chunks of at most
+``chunk_factor × mean`` entries, each chunk computing a partial Gram/RHS that a
+``segment_sum`` combines per row before the solve. A Zipf head row therefore
+costs proportionally more chunks instead of inflating every row's padding
+(the round-1 ``pad_csr_lists`` padded all rows to the global max row length);
+rows are dealt to workers by balanced (serpentine-LPT) entry counts. The
+reference ingested exactly such power-law CSR data
+(HarpDAALDataSource.regroupCOOList:399).
 """
 
 from __future__ import annotations
@@ -33,10 +42,15 @@ class ALSConfig:
     alpha: float = 40.0         # implicit confidence weight (DAAL: alpha)
     iterations: int = 10
     implicit: bool = True
+    balance: bool = True        # serpentine-LPT row→worker assignment
+    chunk_factor: float = 2.0   # chunk cap = ceil(chunk_factor * mean entries)
 
 
 def pad_csr_lists(rows, cols, vals, num_rows, num_workers):
-    """(entity → padded neighbor list): idx (R_pad, M), val (R_pad, M), mask."""
+    """(entity → padded neighbor list): idx (R_pad, M), val (R_pad, M), mask.
+
+    Round-1 layout (pads every row to the global max row length) — kept for
+    callers with uniform data; ALS itself uses :func:`pad_csr_chunks`."""
     order = np.argsort(rows, kind="stable")
     r, c, v = rows[order], cols[order], vals[order]
     rpw = -(-num_rows // num_workers)
@@ -54,43 +68,107 @@ def pad_csr_lists(rows, cols, vals, num_rows, num_workers):
     return idx, val, mask
 
 
-def _half_step(factor_other, idx, val, mask, cfg: ALSConfig,
-               axis_name: str = WORKERS):
+def pad_csr_chunks(rows, cols, vals, num_rows, num_workers,
+                   chunk_factor: float = 2.0, balance: bool = True):
+    """Skew-robust CSR layout: capped chunks + per-row segment ids.
+
+    Returns (idx (W, NC, C), val, mask, chunk_row (W, NC) local row slot,
+    (row_bin, row_slot), rpw, stats). Padded chunks point at slot 0 with an
+    all-zero mask.
+    """
+    from harp_tpu.models.sgd_mf import identity_assign, serpentine_assign
+
+    nnz = len(rows)
+    counts_global = np.bincount(rows, minlength=num_rows)
+    if balance and nnz:
+        row_bin, row_slot = serpentine_assign(counts_global, num_workers)
+    else:
+        row_bin, row_slot = identity_assign(num_rows, num_workers)
+    rpw = -(-num_rows // num_workers)
+    cap = max(1, int(np.ceil(chunk_factor * max(nnz, 1)
+                             / max(num_rows, 1))))
+    # order entries by (worker, row slot); chunks are consecutive runs of cap
+    owner = row_bin[rows]
+    slot = row_slot[rows]
+    order = np.lexsort((slot, owner))
+    o_own, o_slot = owner[order], slot[order]
+    o_cols, o_vals = cols[order], vals[order]
+    # position of each entry within its row  →  chunk id within the row
+    row_key = o_own.astype(np.int64) * rpw + o_slot
+    starts = np.concatenate([[0], np.cumsum(np.bincount(
+        row_key, minlength=num_workers * rpw))])
+    pos_in_row = np.arange(nnz) - starts[row_key]
+    chunk_of_entry = pos_in_row // cap
+    pos_in_chunk = pos_in_row % cap
+    # number the chunks per worker
+    n_chunks_per_row = -(-counts_global // cap)      # per global row id
+    chunks_per_worker = np.zeros(num_workers, np.int64)
+    np.add.at(chunks_per_worker, row_bin, n_chunks_per_row)
+    nc = max(int(chunks_per_worker.max()), 1)
+    # chunk index within worker: cumulative chunks of earlier slots + chunk id
+    chunk_base = np.zeros((num_workers, rpw), np.int64)
+    np.add.at(chunk_base, (row_bin, row_slot), n_chunks_per_row)
+    chunk_base = np.cumsum(chunk_base, axis=1) - chunk_base
+    entry_chunk = chunk_base[o_own, o_slot] + chunk_of_entry
+
+    idx = np.zeros((num_workers, nc, cap), np.int32)
+    val = np.zeros((num_workers, nc, cap), np.float32)
+    mask = np.zeros((num_workers, nc, cap), np.float32)
+    chunk_row = np.zeros((num_workers, nc), np.int32)
+    idx[o_own, entry_chunk, pos_in_chunk] = o_cols
+    val[o_own, entry_chunk, pos_in_chunk] = o_vals
+    mask[o_own, entry_chunk, pos_in_chunk] = 1.0
+    chunk_row[o_own, entry_chunk] = o_slot
+    stats = {"padded": int(idx.size), "nnz": nnz,
+             "overhead": idx.size / max(nnz, 1), "chunk_cap": cap}
+    return idx, val, mask, chunk_row, (row_bin, row_slot), rpw, stats
+
+
+def _half_step(factor_other, idx, val, mask, chunk_row, rpw: int,
+               cfg: ALSConfig):
     """Solve this worker's block of one side's normal equations.
 
-    factor_other: replicated (E_other, K). idx/val/mask: this worker's padded
-    lists (E_local, M). Returns the updated local block (E_local, K).
-    """
+    factor_other: replicated (E_other, K) in the OTHER side's permuted slot
+    order (idx entries are pre-remapped on the host). idx/val/mask:
+    (NC, C) capped chunks; chunk_row: (NC,) local row slot per chunk.
+    Returns the updated local block (rpw, K)."""
     k = cfg.rank
-    vi = factor_other[idx]                      # (E_local, M, K)
-    vi = vi * mask[..., None]
+    vi = factor_other[idx] * mask[..., None]     # (NC, C, K)
     if cfg.implicit:
         # Hu, Koren, Volinsky: A = V'V + V'(C−I)V + λI;  b = V'C·p (p=1 observed)
-        conf = cfg.alpha * val * mask          # c − 1
-        gram = jax.lax.dot_general(             # V'V over ALL entities (replicated)
-            factor_other, factor_other, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        a = gram[None] + jnp.einsum("emk,em,eml->ekl", vi, conf, vi)
-        b = jnp.einsum("emk,em->ek", vi, (1.0 + conf) * mask)
+        conf = cfg.alpha * val * mask            # c − 1
+        a_part = jnp.einsum("cmk,cm,cml->ckl", vi, conf, vi)
+        b_part = jnp.einsum("cmk,cm->ck", vi, (1.0 + conf) * mask)
     else:
         # explicit: normal equations over observed entries only
-        a = jnp.einsum("emk,eml->ekl", vi, vi)
-        b = jnp.einsum("emk,em->ek", vi, val * mask)
+        a_part = jnp.einsum("cmk,cml->ckl", vi, vi)
+        b_part = jnp.einsum("cmk,cm->ck", vi, val * mask)
+    a = jax.ops.segment_sum(a_part, chunk_row, num_segments=rpw)
+    b = jax.ops.segment_sum(b_part, chunk_row, num_segments=rpw)
+    if cfg.implicit:
+        gram = jax.lax.dot_general(              # V'V over ALL entities
+            factor_other, factor_other, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        a = a + gram[None]
     a = a + cfg.lam * jnp.eye(k, dtype=a.dtype)[None]
     return jax.scipy.linalg.solve(a, b[..., None], assume_a="pos")[..., 0]
 
 
-def _train(u_idx, u_val, u_mask, i_idx, i_val, i_mask, u0, v0, cfg: ALSConfig,
+def _train(u_data, i_data, u0, v0, u_rpw: int, i_rpw: int, cfg: ALSConfig,
            axis_name: str = WORKERS):
+    u_idx, u_val, u_mask, u_crow = u_data
+    i_idx, i_val, i_mask, i_crow = i_data
+
     def iteration(carry, _):
         u, v = carry                             # both replicated (E, K)
         # users half-step: local block solve, then re-replicate
-        u_block = _half_step(v, u_idx, u_val, u_mask, cfg, axis_name)
+        u_block = _half_step(v, u_idx, u_val, u_mask, u_crow, u_rpw, cfg)
         u = lax_ops.allgather(u_block, axis_name)
-        v_block = _half_step(u, i_idx, i_val, i_mask, cfg, axis_name)
+        v_block = _half_step(u, i_idx, i_val, i_mask, i_crow, i_rpw, cfg)
         v = lax_ops.allgather(v_block, axis_name)
-        # monitor: explicit squared error on observed entries of the user shard
-        pred = jnp.einsum("emk,ek->em", v[u_idx] * u_mask[..., None], u_block)
+        # monitor: squared error on observed entries of the user-side chunks
+        pred = jnp.einsum("cmk,ck->cm", v[u_idx] * u_mask[..., None],
+                          u_block[u_crow])
         tgt = u_val if not cfg.implicit else (u_mask * 1.0)
         sse = jax.lax.psum(jnp.sum(u_mask * (tgt - pred) ** 2), axis_name)
         cnt = jax.lax.psum(jnp.sum(u_mask), axis_name)
@@ -108,33 +186,66 @@ class ALS:
         self.session = session
         self.config = config
         self._fns = {}
+        self.last_layout_stats: dict = {}
 
     def fit(self, rows, cols, vals, num_users: int, num_items: int,
             seed: int = 0) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Returns (U (num_users, K), V (num_items, K), rmse-per-iteration)."""
+        from harp_tpu.models.sgd_mf import _validate_coo
+
         sess, cfg = self.session, self.config
         w = sess.num_workers
-        u_idx, u_val, u_mask = pad_csr_lists(rows, cols, vals, num_users, w)
-        i_idx, i_val, i_mask = pad_csr_lists(cols, rows, vals, num_items, w)
+        rows = np.asarray(rows)
+        cols = np.asarray(cols)
+        vals = np.asarray(vals, np.float32)
+        _validate_coo(rows, cols, num_users, num_items)
+        u_layout = pad_csr_chunks(rows, cols, vals, num_users, w,
+                                  cfg.chunk_factor, cfg.balance)
+        i_layout = pad_csr_chunks(cols, rows, vals, num_items, w,
+                                  cfg.chunk_factor, cfg.balance)
+        u_idx, u_val, u_mask, u_crow, u_assign, u_rpw, u_stats = u_layout
+        i_idx, i_val, i_mask, i_crow, i_assign, i_rpw, i_stats = i_layout
+        self.last_layout_stats = {
+            "users": u_stats, "items": i_stats,
+            "overhead": max(u_stats["overhead"], i_stats["overhead"]),
+        }
+        # chunk idx entries address the OTHER side's replicated factor, which
+        # lives in permuted slot order after allgather — remap on the host
+        ib, isl = i_assign
+        u_idx = (ib[u_idx].astype(np.int64) * i_rpw + isl[u_idx]).astype(np.int32)
+        ub, usl = u_assign
+        i_idx = (ub[i_idx].astype(np.int64) * u_rpw + usl[i_idx]).astype(np.int32)
+
         rng = np.random.default_rng(seed)
         scale = 1.0 / np.sqrt(cfg.rank)
-        u0 = (scale * rng.random((u_idx.shape[0], cfg.rank))).astype(np.float32)
-        v0 = (scale * rng.random((i_idx.shape[0], cfg.rank))).astype(np.float32)
-        # zero phantom padding rows: the implicit-mode gram V'V sums over ALL
+        u0 = (scale * rng.random((w * u_rpw, cfg.rank))).astype(np.float32)
+        v0 = (scale * rng.random((w * i_rpw, cfg.rank))).astype(np.float32)
+        # zero phantom padding slots: the implicit-mode gram V'V sums over ALL
         # rows of the replicated factor, so random init there would bias the
         # first half-iteration's normal equations
-        u0[num_users:] = 0.0
-        v0[num_items:] = 0.0
+        used_u = np.zeros(w * u_rpw, bool)
+        used_u[ub.astype(np.int64)[:num_users] * u_rpw + usl[:num_users]] = True
+        u0[~used_u] = 0.0
+        used_v = np.zeros(w * i_rpw, bool)
+        used_v[ib.astype(np.int64)[:num_items] * i_rpw + isl[:num_items]] = True
+        v0[~used_v] = 0.0
 
-        key = (u_idx.shape, i_idx.shape)
+        key = (u_idx.shape, i_idx.shape, u_rpw, i_rpw)
         if key not in self._fns:
             self._fns[key] = sess.spmd(
-                lambda a, b, c, d, e, f, g, h: _train(a, b, c, d, e, f, g, h, cfg),
-                in_specs=(sess.shard(),) * 6 + (sess.replicate(),) * 2,
+                lambda a, b, c, d, e, f, g, h, i, j: _train(
+                    (a[0], b[0], c[0], d[0]), (e[0], f[0], g[0], h[0]),
+                    i, j, u_rpw, i_rpw, cfg),
+                in_specs=(sess.shard(),) * 8 + (sess.replicate(),) * 2,
                 out_specs=(sess.replicate(),) * 3)
         u, v, rmse = self._fns[key](
             sess.scatter(u_idx), sess.scatter(u_val), sess.scatter(u_mask),
+            sess.scatter(u_crow),
             sess.scatter(i_idx), sess.scatter(i_val), sess.scatter(i_mask),
+            sess.scatter(i_crow),
             sess.replicate_put(u0), sess.replicate_put(v0))
-        return (np.asarray(u)[:num_users], np.asarray(v)[:num_items],
-                np.asarray(rmse))
+        u = np.asarray(u)
+        v = np.asarray(v)
+        u_final = u[ub.astype(np.int64)[:num_users] * u_rpw + usl[:num_users]]
+        v_final = v[ib.astype(np.int64)[:num_items] * i_rpw + isl[:num_items]]
+        return u_final, v_final, np.asarray(rmse)

@@ -119,13 +119,17 @@ class LDA:
         self._fns = {}
         self.last_layout_stats: dict = {}
 
+    def _effective_minibatches(self, d_local: int) -> int:
+        """Largest divisor of docs-per-worker within the configured budget —
+        the sub-step count the compiled program actually runs."""
+        return max(g for g in range(1, min(self.config.minibatches_per_hop,
+                                           d_local) + 1) if d_local % g == 0)
+
     def _build(self, w: int, v_pad: int, lb: int, d_local: int):
         cfg = self.config
         k = cfg.num_topics
         vpb = v_pad // w                      # vocab per block
-        # sequential doc-group sub-steps per hop (largest divisor that fits)
-        nmb = max(g for g in range(1, min(cfg.minibatches_per_hop,
-                                          d_local) + 1) if d_local % g == 0)
+        nmb = self._effective_minibatches(d_local)
         dg = d_local // nmb
 
         def fit_fn(docs_b, mask_b, z0, wt_block0, seed):
@@ -222,7 +226,9 @@ class LDA:
                     hop_body, (doc_topic, z, topic_tot, key), wt, w)
                 # REFERENCE log-likelihood (CalcLikelihoodTask.run:56 +
                 # printLikelihood:731-748): nonzero word-topic cells only,
-                # then the topic-sum completion terms
+                # then the topic-sum completion terms. Exact for CGS (integer
+                # counts); under CVB0 counts are fractional soft mass, so the
+                # >0.5 cell test makes this an approximate monitor there
                 nz = wt > 0.5
                 ll_w = jax.lax.psum(
                     jnp.sum(jnp.where(nz, lgamma(wt + cfg.beta)
@@ -276,9 +282,7 @@ class LDA:
         docs_b, mask_b, lb = bucketize_tokens(docs, w, vpb, word_block,
                                               word_slot)
         d_local = num_docs // w
-        nmb_eff = max(g for g in range(1, min(cfg.minibatches_per_hop,
-                                              d_local) + 1)
-                      if d_local % g == 0)
+        nmb_eff = self._effective_minibatches(d_local)
         self.last_layout_stats = {
             "padded": int(docs_b.size), "tokens": int(docs.size),
             "overhead": docs_b.size / max(docs.size, 1),
