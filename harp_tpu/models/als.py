@@ -223,11 +223,13 @@ class ALS:
         # zero phantom padding slots: the implicit-mode gram V'V sums over ALL
         # rows of the replicated factor, so random init there would bias the
         # first half-iteration's normal equations
+        u_slots = ub.astype(np.int64)[:num_users] * u_rpw + usl[:num_users]
+        v_slots = ib.astype(np.int64)[:num_items] * i_rpw + isl[:num_items]
         used_u = np.zeros(w * u_rpw, bool)
-        used_u[ub.astype(np.int64)[:num_users] * u_rpw + usl[:num_users]] = True
+        used_u[u_slots] = True
         u0[~used_u] = 0.0
         used_v = np.zeros(w * i_rpw, bool)
-        used_v[ib.astype(np.int64)[:num_items] * i_rpw + isl[:num_items]] = True
+        used_v[v_slots] = True
         v0[~used_v] = 0.0
 
         key = (u_idx.shape, i_idx.shape, u_rpw, i_rpw)
@@ -244,8 +246,6 @@ class ALS:
             sess.scatter(i_idx), sess.scatter(i_val), sess.scatter(i_mask),
             sess.scatter(i_crow),
             sess.replicate_put(u0), sess.replicate_put(v0))
-        u = np.asarray(u)
-        v = np.asarray(v)
-        u_final = u[ub.astype(np.int64)[:num_users] * u_rpw + usl[:num_users]]
-        v_final = v[ib.astype(np.int64)[:num_items] * i_rpw + isl[:num_items]]
+        u_final = np.asarray(u)[u_slots]
+        v_final = np.asarray(v)[v_slots]
         return u_final, v_final, np.asarray(rmse)
