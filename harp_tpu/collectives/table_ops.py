@@ -270,23 +270,19 @@ def group_by_key_sharded(
     rv = rv.reshape((-1,) + rv.shape[2:])
     rm_f = rm.reshape(-1).astype(rv.dtype).reshape(
         (-1,) + (1,) * (rv.ndim - 1))
+    # invalid slots are already excluded: their segment id is redirected to
+    # the kpw overflow row, which the [:kpw] slice drops
     if combiner.op in (combiner_lib.Op.SUM, combiner_lib.Op.AVG):
-        out = jax.ops.segment_sum(rv * rm_f, lk, num_segments=kpw + 1)[:kpw]
+        out = jax.ops.segment_sum(rv, lk, num_segments=kpw + 1)[:kpw]
         if combiner.op is combiner_lib.Op.AVG:
             cnt = jax.ops.segment_sum(rm.reshape(-1), lk,
                                       num_segments=kpw + 1)[:kpw]
             out = out / jnp.maximum(cnt, 1.0).astype(out.dtype).reshape(
                 (-1,) + (1,) * (out.ndim - 1))
     elif combiner.op in (combiner_lib.Op.MAX, combiner_lib.Op.MIN):
-        fill = (jnp.finfo(rv.dtype).min if combiner.op is combiner_lib.Op.MAX
-                else jnp.finfo(rv.dtype).max) if jnp.issubdtype(
-            rv.dtype, jnp.floating) else (
-            jnp.iinfo(rv.dtype).min if combiner.op is combiner_lib.Op.MAX
-            else jnp.iinfo(rv.dtype).max)
-        masked = jnp.where(rm_f > 0, rv, fill)
         seg = (jax.ops.segment_max if combiner.op is combiner_lib.Op.MAX
                else jax.ops.segment_min)
-        out = seg(masked, lk, num_segments=kpw + 1)[:kpw]
+        out = seg(rv, lk, num_segments=kpw + 1)[:kpw]
     else:
         raise ValueError(f"group_by_key_sharded unsupported for {combiner.op}")
     if replicate_result:

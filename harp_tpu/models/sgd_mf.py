@@ -646,6 +646,55 @@ class SGDMF:
         w_final, h_final = self._finalize(w_cur, h_cur, meta)
         return w_final, h_final, np.asarray(rmses), tuner
 
+    def fit_checkpointed(self, state, checkpointer, epochs: Optional[int] = None,
+                         save_every: int = 1
+                         ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+        """Train with periodic checkpointing and automatic resume.
+
+        Runs one compiled epoch per host step (the fit_adaptive granularity);
+        every ``save_every`` epochs the factor state is saved through
+        ``checkpointer`` (utils.checkpoint.Checkpointer). If the checkpoint
+        directory already holds state, training RESUMES from the newest epoch
+        — a capability upgrade over the reference, which restarts from
+        iteration 0 (SURVEY §5; KMUtil.storeCentroids saved final models
+        only). Returns (W, H, rmse-per-epoch-run, first_epoch) where
+        ``first_epoch`` is where this call started (0 for a fresh run).
+
+        The training math is deterministic given (data, factors), so an
+        interrupted + resumed run produces exactly the trajectory of an
+        uninterrupted run at the same per-epoch program granularity.
+        """
+        layout, data, w0, h0, meta = state
+        geom = meta[6]
+        nmb = self.config.minibatches_per_hop
+        epochs = epochs if epochs is not None else self.config.epochs
+        w_cur, h_cur = w0, h0
+        start = 0
+        latest = checkpointer.steps()
+        if latest:
+            start = latest[-1]
+            if start > epochs:
+                raise ValueError(
+                    f"checkpoint at epoch {start} exceeds the requested "
+                    f"{epochs} epochs — the saved model is already trained "
+                    f"past this budget (pass a fresh checkpoint directory "
+                    f"or a larger epochs)")
+            saved = checkpointer.restore(start, like={"w": np.asarray(w0),
+                                                      "h": np.asarray(h0)})
+            w_cur = jax.device_put(saved["w"], w0.sharding)
+            h_cur = jax.device_put(saved["h"], h0.sharding)
+        key = self._program(layout, nmb, 1, geom)
+        fn = self._compiled[key]
+        rmses = []
+        for epoch in range(start, epochs):
+            w_cur, h_cur, r = fn(*data, w_cur, h_cur)
+            rmses.append(np.asarray(r)[0])
+            if (epoch + 1) % save_every == 0 or epoch + 1 == epochs:
+                checkpointer.save(epoch + 1, {"w": np.asarray(w_cur),
+                                              "h": np.asarray(h_cur)})
+        w_final, h_final = self._finalize(w_cur, h_cur, meta)
+        return w_final, h_final, np.asarray(rmses), start
+
     def fit(self, rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
             num_rows: int, num_cols: int, seed: int = 0
             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:

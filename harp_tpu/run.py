@@ -1,0 +1,301 @@
+"""Unified per-algorithm launcher surface — ``python -m harp_tpu.run <algo>``.
+
+Reference parity: Harp shipped one CLI launcher per algorithm (``hadoop jar
+harp-java-0.1.0.jar edu.iu.kmeans.regroupallgather.KMeansLauncher ...``,
+README.md:148-160) with standardized arg parsing (data_aux/Initialize.java:97).
+Here one subcommand per BASELINE workload family, with the algorithm-config
+flags derived from the model's config dataclass (harp_tpu.config):
+
+    python -m harp_tpu.run kmeans --num-points 100000 --num-centroids 100 \\
+        --dim 100 --iterations 10 --work-dir /tmp/km
+    python -m harp_tpu.run sgd_mf --num-users 8192 --num-items 8192 \\
+        --epochs 10 --work-dir /tmp/mf --save-every 2      # checkpoint+resume
+    python -m harp_tpu.run lda --num-docs 2048 --vocab 2000 --num-topics 32
+    python -m harp_tpu.run pca --num-points 65536 --dim 256
+    python -m harp_tpu.run nn --num-points 8192 --dim 64 --epochs 10
+
+Every subcommand accepts ``--num-workers N`` (mesh size; defaults to all
+devices) and ``--cpu-mesh`` (force an N-device virtual CPU mesh — the
+reference's multi-mapper local mode). Data is synthetic by default
+(io.datagen — the reference launchers likewise embedded generators); kmeans
+accepts ``--points-file`` for CSV input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+import time
+
+
+def _common_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--num-workers", type=int, default=0,
+                   help="mesh size (0 = all devices; reference: map tasks)")
+    p.add_argument("--cpu-mesh", action="store_true",
+                   help="force a virtual CPU mesh of num-workers devices")
+    p.add_argument("--work-dir", default="",
+                   help="output/checkpoint directory (optional)")
+    p.add_argument("--seed", type=int, default=0)
+
+
+def _session(args):
+    if args.cpu_mesh:
+        n = args.num_workers or 8
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   f" --xla_force_host_platform_device_count={n}")
+    import jax
+
+    if args.cpu_mesh:
+        jax.config.update("jax_platforms", "cpu")
+    from harp_tpu.session import HarpSession
+
+    n = args.num_workers or len(jax.devices())
+    return HarpSession(num_workers=min(n, len(jax.devices())))
+
+
+def _config_from_args(cls, ns, **overrides):
+    import typing
+    hints = typing.get_type_hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if hints.get(f.name) not in (int, float, str, bool):
+            continue
+        v = getattr(ns, f.name, None)
+        if v is not None:
+            kwargs[f.name] = v
+    kwargs.update(overrides)
+    return cls(**kwargs)
+
+
+def _add_config_flags(p, cls):
+    from harp_tpu.config import add_dataclass_args
+
+    add_dataclass_args(p, cls)
+
+
+# --------------------------------------------------------------------------- #
+# Subcommands (one per BASELINE workload family)
+# --------------------------------------------------------------------------- #
+
+def run_kmeans(argv) -> int:
+    from harp_tpu.models.kmeans import KMeansConfig
+
+    p = argparse.ArgumentParser(prog="harp_tpu.run kmeans")
+    _common_flags(p)
+    p.add_argument("--num-points", type=int, default=100_000)
+    p.add_argument("--points-file", default="")
+    _add_config_flags(p, KMeansConfig)
+    args = p.parse_args(argv)
+    sess = _session(args)
+    import numpy as np
+
+    from harp_tpu.io import datagen, loaders
+    from harp_tpu.models import kmeans as km
+
+    cfg = _config_from_args(km.KMeansConfig, args)
+    if args.points_file:
+        pts = loaders.load_dense_csv([args.points_file])
+    else:
+        pts = datagen.dense_points(args.num_points, cfg.dim, seed=args.seed,
+                                   num_clusters=cfg.num_centroids)
+    pts = pts[: len(pts) - len(pts) % sess.num_workers]
+    cen0 = datagen.initial_centroids(pts, cfg.num_centroids, seed=args.seed + 1)
+    model = km.KMeans(sess, cfg)
+    pts_dev, cen_dev = model.prepare(pts, cen0)
+    model.fit_prepared(pts_dev, cen_dev)          # compile + warmup
+    t0 = time.perf_counter()
+    cen, costs = model.fit_prepared(pts_dev, cen_dev)
+    costs = np.asarray(costs)
+    dt = time.perf_counter() - t0
+    print(f"kmeans[{cfg.comm}] workers={sess.num_workers} n={len(pts)} "
+          f"k={cfg.num_centroids} d={cfg.dim}: {cfg.iterations / dt:.2f} "
+          f"iters/s, cost {costs[0]:.1f} -> {costs[-1]:.1f}")
+    if args.work_dir:
+        os.makedirs(args.work_dir, exist_ok=True)
+        # reference: KMUtil.storeCentroids writes the final model
+        np.savetxt(os.path.join(args.work_dir, "centroids.csv"),
+                   np.asarray(cen), delimiter=",")
+    return 0
+
+
+def run_sgd_mf(argv) -> int:
+    from harp_tpu.models.sgd_mf import SGDMFConfig
+
+    p = argparse.ArgumentParser(prog="harp_tpu.run sgd_mf")
+    _common_flags(p)
+    p.add_argument("--num-users", type=int, default=8192)
+    p.add_argument("--num-items", type=int, default=8192)
+    p.add_argument("--density", type=float, default=0.01)
+    p.add_argument("--adaptive", action="store_true",
+                   help="auto-tune the per-hop budget (adjustMiniBatch analog)")
+    p.add_argument("--save-every", type=int, default=0,
+                   help="checkpoint every N epochs into work-dir (resumes "
+                        "automatically if checkpoints exist)")
+    _add_config_flags(p, SGDMFConfig)
+    args = p.parse_args(argv)
+    sess = _session(args)
+    import numpy as np
+
+    from harp_tpu.io import datagen
+    from harp_tpu.models import sgd_mf
+
+    cfg = _config_from_args(sgd_mf.SGDMFConfig, args)
+    rows, cols, vals = datagen.sparse_ratings(
+        args.num_users, args.num_items, rank=min(cfg.rank, 16),
+        density=args.density, seed=args.seed)
+    model = sgd_mf.SGDMF(sess, cfg)
+    state = model.prepare(rows, cols, vals, args.num_users, args.num_items,
+                          seed=args.seed)
+    t0 = time.perf_counter()
+    if args.save_every and args.work_dir:
+        from harp_tpu.utils.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(os.path.join(args.work_dir, "ckpt"))
+        w, h, rmse, start = model.fit_checkpointed(
+            state, ckpt, save_every=args.save_every)
+        ran = cfg.epochs - start
+    elif args.adaptive:
+        w, h, rmse, tuner = model.fit_adaptive(state)
+        ran = cfg.epochs
+        print(f"tuned budget: {tuner.chosen} "
+              f"(times {dict(sorted(tuner.times.items()))})")
+    else:
+        model.fit_prepared(state)                 # compile + warmup
+        t0 = time.perf_counter()
+        w, h, rmse = model.fit_prepared(state)
+        ran = cfg.epochs
+    dt = time.perf_counter() - t0
+    if ran <= 0 or not len(rmse):
+        print(f"sgd_mf[{model.last_layout_stats['layout']}] "
+              f"workers={sess.num_workers}: fully resumed from checkpoint, "
+              f"nothing left to run")
+        return 0
+    sps = len(vals) * ran / dt
+    print(f"sgd_mf[{model.last_layout_stats['layout']}] "
+          f"workers={sess.num_workers} nnz={len(vals)} rank={cfg.rank}: "
+          f"{sps / 1e6:.2f} M samples/s, rmse {rmse[0]:.4f} -> "
+          f"{rmse[-1]:.4f}")
+    return 0
+
+
+def run_lda(argv) -> int:
+    from harp_tpu.models.lda import LDAConfig
+
+    p = argparse.ArgumentParser(prog="harp_tpu.run lda")
+    _common_flags(p)
+    p.add_argument("--num-docs", type=int, default=1024)
+    p.add_argument("--doc-len", type=int, default=64)
+    _add_config_flags(p, LDAConfig)
+    args = p.parse_args(argv)
+    sess = _session(args)
+    import numpy as np
+
+    from harp_tpu.io import datagen
+    from harp_tpu.models import lda
+
+    cfg = _config_from_args(lda.LDAConfig, args)
+    num_docs = args.num_docs - args.num_docs % sess.num_workers
+    docs = datagen.lda_corpus(num_docs, cfg.vocab,
+                              max(2, cfg.num_topics // 2), args.doc_len,
+                              seed=args.seed)
+    model = lda.LDA(sess, cfg)
+    model.fit(docs, seed=args.seed)               # compile + warmup
+    t0 = time.perf_counter()
+    _, _, ll = model.fit(docs, seed=args.seed)
+    dt = time.perf_counter() - t0
+    toks = docs.size * cfg.epochs
+    print(f"lda[cgs] workers={sess.num_workers} docs={num_docs} "
+          f"vocab={cfg.vocab} K={cfg.num_topics}: {toks / dt / 1e6:.2f} "
+          f"M tokens/s, ll {ll[0]:.4e} -> {ll[-1]:.4e}")
+    return 0
+
+
+def run_pca(argv) -> int:
+    p = argparse.ArgumentParser(prog="harp_tpu.run pca")
+    _common_flags(p)
+    p.add_argument("--num-points", type=int, default=65536)
+    p.add_argument("--dim", type=int, default=256)
+    p.add_argument("--iterations", type=int, default=5,
+                   help="timed repeats")
+    args = p.parse_args(argv)
+    sess = _session(args)
+    import numpy as np
+
+    from harp_tpu.io import datagen
+    from harp_tpu.models import stats
+
+    n = args.num_points - args.num_points % sess.num_workers
+    x = datagen.dense_points(n, args.dim, seed=args.seed)
+    # place once; re-scattering an already-placed array is a no-op, so the
+    # timed loop measures compute, not host->device transfer
+    x_dev = sess.scatter(x)
+    model = stats.PCA(sess)
+    model.fit(x_dev)                              # compile + warmup
+    t0 = time.perf_counter()
+    for _ in range(args.iterations):
+        w, comps, mean = model.fit(x_dev)
+    dt = time.perf_counter() - t0
+    print(f"pca workers={sess.num_workers} n={n} d={args.dim}: "
+          f"{args.iterations / dt:.2f} fits/s, top eigenvalue {w[0]:.4f}")
+    return 0
+
+
+def run_nn(argv) -> int:
+    from harp_tpu.models.nn import NNConfig
+
+    p = argparse.ArgumentParser(prog="harp_tpu.run nn")
+    _common_flags(p)
+    p.add_argument("--num-points", type=int, default=8192)
+    p.add_argument("--dim", type=int, default=64)
+    _add_config_flags(p, NNConfig)
+    args = p.parse_args(argv)
+    sess = _session(args)
+    import numpy as np
+
+    from harp_tpu.io import datagen
+    from harp_tpu.models import nn
+
+    cfg = _config_from_args(nn.NNConfig, args)
+    n = args.num_points - args.num_points % sess.num_workers
+    x, y = datagen.classification_data(n, args.dim, cfg.num_classes,
+                                       seed=args.seed)
+    model = nn.MLPClassifier(sess, cfg)
+    t0 = time.perf_counter()
+    losses = model.fit(x, y, seed=args.seed)
+    dt = time.perf_counter() - t0
+    acc = (model.predict(x) == y).mean()
+    samples = n * cfg.epochs
+    print(f"nn workers={sess.num_workers} n={n} d={args.dim} "
+          f"layers={cfg.layers}: {samples / dt / 1e6:.2f} M samples/s "
+          f"(incl compile), loss {losses[0]:.4f} -> {losses[-1]:.4f}, "
+          f"train acc {acc:.3f}")
+    return 0
+
+
+COMMANDS = {
+    "kmeans": run_kmeans,
+    "sgd_mf": run_sgd_mf,
+    "lda": run_lda,
+    "pca": run_pca,
+    "nn": run_nn,
+}
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        print("subcommands:", ", ".join(sorted(COMMANDS)))
+        return 0
+    cmd = argv[0]
+    if cmd not in COMMANDS:
+        print(f"unknown subcommand {cmd!r}; choose from "
+              f"{', '.join(sorted(COMMANDS))}", file=sys.stderr)
+        return 2
+    return COMMANDS[cmd](argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

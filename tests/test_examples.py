@@ -31,3 +31,55 @@ def test_kmeans_launcher_cli(tmp_path):
     cen = np.loadtxt(os.path.join(work, "centroids.csv"), delimiter=",")
     assert cen.shape == (10, 20)
     assert "cost:" in out.stdout
+
+
+def _run_cmd(args, timeout=300):
+    return subprocess.run(
+        [sys.executable, "-m", "harp_tpu.run"] + args + ["--cpu-mesh",
+                                                         "--num-workers", "8"],
+        env=ENV, cwd=REPO, capture_output=True, text=True, timeout=timeout)
+
+
+def test_run_kmeans_cli(tmp_path):
+    out = _run_cmd(["kmeans", "--num-points", "1024", "--num-centroids", "10",
+                    "--dim", "16", "--iterations", "4",
+                    "--work-dir", str(tmp_path)])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "iters/s" in out.stdout and "cost" in out.stdout
+    assert np.loadtxt(os.path.join(str(tmp_path), "centroids.csv"),
+                      delimiter=",").shape == (10, 16)
+
+
+def test_run_sgd_mf_cli_with_checkpointing(tmp_path):
+    args = ["sgd_mf", "--num-users", "128", "--num-items", "96", "--density",
+            "0.2", "--rank", "8", "--epochs", "6", "--save-every", "2",
+            "--work-dir", str(tmp_path)]
+    out = _run_cmd(args)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "M samples/s" in out.stdout
+    # checkpoints written; a re-run resumes (no epochs left to run)
+    ckpts = os.listdir(os.path.join(str(tmp_path), "ckpt"))
+    assert any(c.startswith("step_") for c in ckpts)
+    out2 = _run_cmd(args)
+    assert out2.returncode == 0, out2.stderr[-2000:]
+
+
+def test_run_lda_cli():
+    out = _run_cmd(["lda", "--num-docs", "64", "--vocab", "48",
+                    "--num-topics", "4", "--doc-len", "16", "--epochs", "3"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "M tokens/s" in out.stdout and "ll" in out.stdout
+
+
+def test_run_pca_cli():
+    out = _run_cmd(["pca", "--num-points", "1024", "--dim", "16",
+                    "--iterations", "2"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "fits/s" in out.stdout and "eigenvalue" in out.stdout
+
+
+def test_run_nn_cli():
+    out = _run_cmd(["nn", "--num-points", "512", "--dim", "8",
+                    "--epochs", "3", "--num-classes", "2"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "train acc" in out.stdout
