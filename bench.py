@@ -1,12 +1,16 @@
 #!/usr/bin/env python
 """Benchmark harness — prints ONE JSON line for the driver.
 
-Flagship workload (BASELINE.json configs[0] scaled to TPU): K-means
-regroupallgather. The reference publishes no absolute throughput (BASELINE.md), so
-``vs_baseline`` anchors against an optimized CPU implementation (numpy/BLAS — the
-same linear-algebra core DAAL uses) of the IDENTICAL workload on this host: the
-north-star is "match DAAL-on-Xeon iteration throughput" and this measures exactly
-that ratio on available hardware.
+Covers the five BASELINE workload configs (BASELINE.json): K-means
+regroupallgather (the flagship/primary metric), SGD-MF (rotate pipeline),
+PCA/covariance (dense allreduce), CGS-LDA (rotation + blocked sampling), and
+mini-batch NN — each anchored against an optimized CPU implementation
+(numpy/BLAS — the same linear-algebra core DAAL uses) of the IDENTICAL
+workload on this host: the reference publishes no absolute throughput
+(BASELINE.md), and the north-star is "match DAAL-on-Xeon iteration
+throughput". A subprocess on an 8-device virtual CPU mesh adds the 1→2→4→8
+strong-scaling curve and the collective micro-benchmarks
+(harp_tpu/benchmark/{scaling,collectives}.py).
 
 Usage: python bench.py [--small]
 """
@@ -14,11 +18,19 @@ Usage: python bench.py [--small]
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+# --------------------------------------------------------------------------- #
+# K-means (BASELINE configs[0] — flagship, primary metric)
+# --------------------------------------------------------------------------- #
 
 def tpu_kmeans_iters_per_sec(n, k, d, iters):
     import jax.numpy as jnp
@@ -53,7 +65,7 @@ def cpu_kmeans_iters_per_sec(n, k, d, iters):
     rng = np.random.default_rng(7)
     pts = rng.random((n, d), dtype=np.float32)
     cen = pts[:k].copy()
-    # one warmup iter
+
     def one_iter(cen):
         x2 = (pts * pts).sum(1, keepdims=True)
         c2 = (cen * cen).sum(1)[None, :]
@@ -65,16 +77,18 @@ def cpu_kmeans_iters_per_sec(n, k, d, iters):
         cnt = oh.sum(0)[:, None]
         return sums / np.maximum(cnt, 1.0)
 
-    cen = one_iter(cen)
+    cen = one_iter(cen)     # warmup
     t0 = time.perf_counter()
     for _ in range(iters):
         cen = one_iter(cen)
     return iters / (time.perf_counter() - t0)
 
 
+# --------------------------------------------------------------------------- #
+# SGD-MF (BASELINE configs[2] — rotate pipeline; dense masked-stripe layout)
+# --------------------------------------------------------------------------- #
+
 def tpu_sgd_mf_samples_per_sec(nu, ni, epochs):
-    """Secondary north-star (BASELINE: 'SGD-MF samples/sec'): steady-state
-    training throughput of the rotation-pipeline MF, device + host prep."""
     from harp_tpu.io import datagen
     from harp_tpu.models import sgd_mf
     from harp_tpu.session import HarpSession
@@ -86,15 +100,16 @@ def tpu_sgd_mf_samples_per_sec(nu, ni, epochs):
                              minibatches_per_hop=8)
     model = sgd_mf.SGDMF(sess, cfg)
     state = model.prepare(rows, cols, vals, nu, ni)
+    nnz = len(vals) - model.last_layout_stats.get("duplicates_dropped", 0)
     model.fit_prepared(state)                    # compile + warm-up
     best, rmse_last = 0.0, 0.0
     for _ in range(3):
         t0 = time.perf_counter()
         _, _, rmse = model.fit_prepared(state)
         dt = time.perf_counter() - t0
-        best = max(best, len(vals) * epochs / dt)
+        best = max(best, nnz * epochs / dt)
         rmse_last = float(rmse[-1])
-    return best, rmse_last
+    return best, rmse_last, model.last_layout_stats["layout"]
 
 
 def cpu_sgd_mf_samples_per_sec(nu, ni, epochs):
@@ -123,6 +138,175 @@ def cpu_sgd_mf_samples_per_sec(nu, ni, epochs):
     return processed / (time.perf_counter() - t0)
 
 
+# --------------------------------------------------------------------------- #
+# PCA / covariance (BASELINE configs[1] — dense allreduce)
+# --------------------------------------------------------------------------- #
+
+def tpu_pca_fits_per_sec(n, d, repeats):
+    from harp_tpu.io import datagen
+    from harp_tpu.models import stats
+    from harp_tpu.session import HarpSession
+
+    sess = HarpSession()
+    n -= n % sess.num_workers
+    x_dev = sess.scatter(datagen.dense_points(n, d, seed=2))
+    model = stats.PCA(sess)
+    model.fit(x_dev)                             # compile + warmup
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        w, _, _ = model.fit(x_dev)               # fit returns host arrays
+    return repeats / (time.perf_counter() - t0), float(w[0])
+
+
+def cpu_pca_fits_per_sec(n, d, repeats):
+    from harp_tpu.io import datagen
+
+    x = datagen.dense_points(n, d, seed=2).astype(np.float64)
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        xc = x - x.mean(0)
+        cov = (xc.T @ xc) / (n - 1)
+        np.linalg.eigh(cov)
+    return repeats / (time.perf_counter() - t0)
+
+
+# --------------------------------------------------------------------------- #
+# CGS-LDA (BASELINE configs[3] — rotation + blocked sampling)
+# --------------------------------------------------------------------------- #
+
+def tpu_lda_tokens_per_sec(num_docs, vocab, doc_len, topics, epochs):
+    from harp_tpu.io import datagen
+    from harp_tpu.models import lda
+    from harp_tpu.session import HarpSession
+
+    sess = HarpSession()
+    num_docs -= num_docs % sess.num_workers
+    docs = datagen.lda_corpus(num_docs, vocab, max(2, topics // 2), doc_len,
+                              seed=3)
+    cfg = lda.LDAConfig(num_topics=topics, vocab=vocab, epochs=epochs)
+    model = lda.LDA(sess, cfg)
+    model.fit(docs, seed=1)                      # compile + warmup
+    t0 = time.perf_counter()
+    _, _, ll = model.fit(docs, seed=1)
+    dt = time.perf_counter() - t0
+    return docs.size * epochs / dt, float(ll[-1])
+
+
+def cpu_lda_tokens_per_sec(num_docs, vocab, doc_len, topics, epochs):
+    """Vectorized numpy blocked-CGS sweep — same blocked math as the device."""
+    from harp_tpu.io import datagen
+
+    docs = datagen.lda_corpus(num_docs, vocab, max(2, topics // 2), doc_len,
+                              seed=3)
+    rng = np.random.default_rng(1)
+    d, l = docs.shape
+    z = rng.integers(0, topics, (d, l))
+    ndk = np.zeros((d, topics))
+    np.add.at(ndk, (np.arange(d)[:, None], z), 1)
+    nwk = np.zeros((vocab, topics))
+    np.add.at(nwk, (docs, z), 1)
+    nk = ndk.sum(0)
+    alpha, beta = 0.1, 0.01
+    t0 = time.perf_counter()
+    for _ in range(epochs):
+        cur = np.zeros((d, l, topics))
+        np.put_along_axis(cur, z[..., None], 1.0, axis=2)
+        p = ((ndk[:, None, :] - cur + alpha)
+             * (nwk[docs] - cur + beta)
+             / (nk[None, None, :] - cur + vocab * beta))
+        p = np.maximum(p, 1e-12)
+        p /= p.sum(-1, keepdims=True)
+        u = rng.random((d, l, 1))
+        z = (p.cumsum(-1) < u).sum(-1).clip(0, topics - 1)
+        ndk = np.zeros((d, topics))
+        np.add.at(ndk, (np.arange(d)[:, None], z), 1)
+        nwk = np.zeros((vocab, topics))
+        np.add.at(nwk, (docs, z), 1)
+        nk = ndk.sum(0)
+    return docs.size * epochs / (time.perf_counter() - t0)
+
+
+# --------------------------------------------------------------------------- #
+# Mini-batch NN (BASELINE configs[4] — mini-batch allreduce)
+# --------------------------------------------------------------------------- #
+
+def tpu_nn_samples_per_sec(n, d, epochs):
+    from harp_tpu.io import datagen
+    from harp_tpu.models import nn
+    from harp_tpu.session import HarpSession
+
+    sess = HarpSession()
+    n -= n % sess.num_workers
+    cfg = nn.NNConfig(layers=(256, 128), num_classes=16, lr=0.05,
+                      batch_size=512, epochs=epochs)
+    import jax.numpy as jnp
+
+    x, y = datagen.classification_data(n, d, cfg.num_classes, seed=4)
+    # place once: fit's internal scatter is a no-op on placed arrays, so the
+    # timed run measures training, not host->device transfer
+    x_dev = sess.scatter(jnp.asarray(x, jnp.float32))
+    y_dev = sess.scatter(jnp.asarray(y, jnp.int32))
+    model = nn.MLPClassifier(sess, cfg)
+    model.fit(x_dev, y_dev, seed=0)              # compile + warmup
+    t0 = time.perf_counter()
+    losses = model.fit(x_dev, y_dev, seed=0)
+    dt = time.perf_counter() - t0
+    return n * epochs / dt, float(losses[-1])
+
+
+def cpu_nn_samples_per_sec(n, d, epochs):
+    from harp_tpu.io import datagen
+
+    x, y = datagen.classification_data(n, d, 16, seed=4)
+    rng = np.random.default_rng(0)
+    dims = [d, 256, 128, 16]
+    ws = [rng.standard_normal((a, b)).astype(np.float32) * np.sqrt(2.0 / a)
+          for a, b in zip(dims[:-1], dims[1:])]
+    bs_ = [np.zeros(b, np.float32) for b in dims[1:]]
+    bsz, lr = 512, 0.05
+    t0 = time.perf_counter()
+    for _ in range(epochs):
+        for i in range(0, n - bsz + 1, bsz):
+            xb, yb = x[i:i + bsz], y[i:i + bsz]
+            acts = [xb]
+            h = xb
+            for w, b in zip(ws[:-1], bs_[:-1]):
+                h = np.maximum(h @ w + b, 0.0)
+                acts.append(h)
+            logits = h @ ws[-1] + bs_[-1]
+            e = np.exp(logits - logits.max(1, keepdims=True))
+            probs = e / e.sum(1, keepdims=True)
+            probs[np.arange(bsz), yb] -= 1.0
+            g = probs / bsz
+            for li in range(len(ws) - 1, -1, -1):
+                gw = acts[li].T @ g
+                gb = g.sum(0)
+                if li:
+                    g = (g @ ws[li].T) * (acts[li] > 0)
+                ws[li] -= lr * gw
+                bs_[li] -= lr * gb
+    return n * epochs / (time.perf_counter() - t0)
+
+
+# --------------------------------------------------------------------------- #
+# Scaling + collectives (subprocess on the 8-device virtual CPU mesh)
+# --------------------------------------------------------------------------- #
+
+def mesh_scaling_and_collectives(timeout=600):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "harp_tpu.benchmark.scaling"],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=timeout)
+        if out.returncode == 0:
+            return json.loads(out.stdout.strip().splitlines()[-1])
+        return {"error": out.stderr[-500:]}
+    except Exception as e:             # noqa: BLE001 — bench must not die here
+        return {"error": str(e)}
+
+
 def main():
     small = "--small" in sys.argv
     n, k, d = (100_000, 100, 100) if small else (1_000_000, 100, 100)
@@ -132,9 +316,27 @@ def main():
     tpu_ips, final_cost = tpu_kmeans_iters_per_sec(n, k, d, tpu_iters)
     cpu_ips = cpu_kmeans_iters_per_sec(n, k, d, cpu_iters)
 
-    nu = 2048 if small else 8192
-    sgd_sps, sgd_rmse = tpu_sgd_mf_samples_per_sec(nu, nu, epochs=3)
+    nu = 4096 if small else 32768
+    sgd_epochs = 3 if small else 10
+    sgd_sps, sgd_rmse, sgd_layout = tpu_sgd_mf_samples_per_sec(
+        nu, nu, epochs=sgd_epochs)
     sgd_cpu = cpu_sgd_mf_samples_per_sec(nu, nu, epochs=1)
+
+    pn, pd = (32768, 64) if small else (262144, 256)
+    pca_fps, pca_top = tpu_pca_fits_per_sec(pn, pd, repeats=3 if small else 5)
+    pca_cpu = cpu_pca_fits_per_sec(pn, pd, repeats=2)
+
+    ld, lv, ll_, lk = (256, 300, 32, 8) if small else (2048, 2000, 128, 32)
+    lda_tps, lda_ll = tpu_lda_tokens_per_sec(ld, lv, ll_, lk,
+                                             epochs=2 if small else 5)
+    lda_cpu = cpu_lda_tokens_per_sec(ld // 4, lv, ll_, lk, epochs=1)
+
+    nn_n, nn_d = (8192, 64) if small else (65536, 128)
+    nn_sps, nn_loss = tpu_nn_samples_per_sec(nn_n, nn_d,
+                                             epochs=3 if small else 20)
+    nn_cpu = cpu_nn_samples_per_sec(nn_n, nn_d, epochs=1)
+
+    mesh = mesh_scaling_and_collectives()
 
     print(json.dumps({
         "metric": f"kmeans_regroupallgather_iters_per_sec_n{n}_k{k}_d{d}",
@@ -146,6 +348,18 @@ def main():
         "sgd_mf_samples_per_sec": round(sgd_sps),
         "sgd_mf_vs_cpu": round(sgd_sps / sgd_cpu, 2),
         "sgd_mf_final_rmse": round(sgd_rmse, 4),
+        "sgd_mf_layout": sgd_layout,
+        "pca_fits_per_sec": round(pca_fps, 3),
+        "pca_vs_cpu": round(pca_fps / pca_cpu, 2),
+        "pca_top_eigenvalue": round(pca_top, 5),
+        "lda_tokens_per_sec": round(lda_tps),
+        "lda_vs_cpu": round(lda_tps / lda_cpu, 2),
+        "lda_final_ll": lda_ll,
+        "nn_samples_per_sec": round(nn_sps),
+        "nn_vs_cpu": round(nn_sps / nn_cpu, 2),
+        "nn_final_loss": round(nn_loss, 4),
+        "scaling_efficiency": mesh.get("scaling_efficiency", mesh),
+        "collectives_8w_cpu_mesh": mesh.get("collectives", {}),
     }))
 
 

@@ -1,0 +1,93 @@
+"""Scaling-efficiency + collective micro-benchmarks on the virtual CPU mesh.
+
+BASELINE's north star includes "scaling efficiency 1→64 chips"; real multi-chip
+hardware is not available to the harness, so this module measures the 1→2→4→8
+curve on a virtual 8-device CPU mesh (``xla_force_host_platform_device_count``)
+— absolute numbers are host-bound, but the curve validates the SPMD harness and
+catches collective-layout regressions (the same reason the reference shipped
+BenchmarkMapper). Run as::
+
+    python -m harp_tpu.benchmark.scaling
+
+prints ONE JSON line:
+``{"scaling_efficiency": {...}, "collectives": {...}}`` — consumed by bench.py
+and by ``__graft_entry__.dryrun_multichip``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def measure(widths=(1, 2, 4, 8), n=65536, d=64, k=64, iters=20) -> dict:
+    import jax
+
+    import numpy as np
+
+    from harp_tpu.benchmark.collectives import bench_collectives
+    from harp_tpu.io import datagen
+    from harp_tpu.models import kmeans as km
+    from harp_tpu.session import HarpSession
+
+    assert len(jax.devices()) >= max(widths), (
+        f"need {max(widths)} devices, have {len(jax.devices())}")
+    pts = datagen.dense_points(n, d, seed=0, num_clusters=k)
+    cen0 = datagen.initial_centroids(pts, k, seed=1)
+    times = {}
+    for w in widths:
+        sess = HarpSession(num_workers=w, devices=jax.devices()[:w])
+        model = km.KMeans(sess, km.KMeansConfig(k, d, iters,
+                                                "regroupallgather"))
+        pts_dev, cen_dev = model.prepare(pts, cen0)
+        np.asarray(model.fit_prepared(pts_dev, cen_dev)[1])   # compile+warm
+        best = np.inf
+        for _ in range(2):
+            t0 = time.perf_counter()
+            np.asarray(model.fit_prepared(pts_dev, cen_dev)[1])
+            best = min(best, time.perf_counter() - t0)
+        times[w] = best
+    t1 = times[widths[0]]
+    scaling = {
+        "workload": f"kmeans fixed-total-work n={n} d={d} k={k} iters={iters}",
+        "seconds": {str(w): round(t, 4) for w, t in times.items()},
+        # Virtual devices share the host's cores (often just 1 in CI), so
+        # classic strong/weak efficiency is meaningless here. The meaningful
+        # harness metric is DISTRIBUTION OVERHEAD: t(W)/t(1) at fixed total
+        # work — ~1.0 means sharding + collectives add no cost; a regression
+        # in collective layout shows up as growth with W.
+        "distribution_overhead": {str(w): round(times[w] / t1, 3)
+                                  for w in widths},
+        "note": "virtual CPU mesh; overhead<=~1.2 healthy, real chip scaling "
+                "requires multi-chip hardware",
+    }
+
+    sess8 = HarpSession(num_workers=max(widths),
+                        devices=jax.devices()[:max(widths)])
+    coll = {}
+    for r in bench_collectives(sess8, sizes_kb=[1024], loops=20,
+                               ops=("allreduce", "allgather", "reduce_scatter",
+                                    "rotate", "all_to_all")):
+        coll[r.op] = {"size_bytes": r.size_bytes,
+                      "us_per_op": round(r.us_per_op, 1),
+                      "gbps": round(r.gbps, 2)}
+    return {"scaling_efficiency": scaling, "collectives": coll}
+
+
+def main() -> None:
+    # must run before jax initializes; the image's sitecustomize force-selects
+    # the TPU backend via jax.config, so override both
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    print(json.dumps(measure()))
+
+
+if __name__ == "__main__":
+    main()

@@ -209,6 +209,62 @@ def group_by_key(
     raise ValueError(f"group_by_key unsupported for {combiner.op}")
 
 
+def bucket_route(dest: jax.Array, capacity: int, payloads,
+                 valid: Optional[jax.Array] = None,
+                 axis_name: str = WORKERS):
+    """Fixed-capacity owner routing — the shared shuffle core.
+
+    Routes each record (one row of every array in ``payloads``) to worker
+    ``dest[i]`` through one ``all_to_all`` of static (W, capacity) buckets.
+    ``valid=False`` rows (and any with ``dest >= W``) are excluded without
+    consuming capacity. Returns ``(routed, recv_mask, overflow, routing)``:
+    ``routed`` mirrors ``payloads`` with shapes (W, capacity, ...);
+    ``recv_mask`` marks filled slots; ``overflow`` is the psum'd count of
+    VALID records dropped for capacity; ``routing`` feeds
+    :func:`route_back`."""
+    w = jax.lax.axis_size(axis_name)
+    n = dest.shape[0]
+    # invalid records route to a virtual "drop" destination w so they never
+    # consume a real bucket's capacity
+    dest = jnp.where(valid if valid is not None else True, dest, w)
+    order = jnp.argsort(dest, stable=True)
+    d_s = dest[order]
+    counts = jnp.bincount(d_s, length=w + 1)
+    starts = jnp.cumsum(counts) - counts
+    pos = jnp.arange(n) - starts[d_s]
+    ok = (pos < capacity) & (d_s < w)
+    d_c = jnp.minimum(d_s, w - 1)
+    pos_c = jnp.minimum(pos, capacity - 1)
+    routed = []
+    for p in payloads:
+        p_s = p[order]
+        okf = ok.astype(p_s.dtype).reshape((n,) + (1,) * (p_s.ndim - 1))
+        # valid positions are unique → masked scatter-add == set; excluded
+        # rows clamp to the last slot but add zeros
+        buf = jnp.zeros((w, capacity) + p_s.shape[1:], p_s.dtype
+                        ).at[d_c, pos_c].add(p_s * okf)
+        routed.append(jax.lax.all_to_all(buf, axis_name, split_axis=0,
+                                         concat_axis=0))
+    buf_m = jnp.zeros((w, capacity), jnp.float32).at[d_c, pos_c].add(
+        ok.astype(jnp.float32))
+    recv_mask = jax.lax.all_to_all(buf_m, axis_name, split_axis=0,
+                                   concat_axis=0)
+    overflow = jax.lax.psum(jnp.sum((~ok) & (d_s < w)), axis_name)
+    routing = (order, d_c, pos_c, ok, n)
+    return routed, recv_mask, overflow, routing
+
+
+def route_back(answers, routing, axis_name: str = WORKERS):
+    """Return per-slot answers (W, capacity, ...) to the senders, restoring
+    the original record order. Second output marks records whose answer
+    actually made the round trip (False for capacity-dropped records)."""
+    back = jax.lax.all_to_all(answers, axis_name, split_axis=0, concat_axis=0)
+    order, d_c, pos_c, ok, n = routing
+    picked = back[d_c, pos_c]
+    inv = jnp.zeros_like(order).at[order].set(jnp.arange(n))
+    return picked[inv], ok[inv]
+
+
 def group_by_key_sharded(
     keys: jax.Array,
     values: jax.Array,
@@ -240,30 +296,8 @@ def group_by_key_sharded(
     n = keys.shape[0]
     cap = capacity or max(1, 2 * -(-n // w))
     dest = jnp.minimum(keys // kpw, w - 1)
-    order = jnp.argsort(dest, stable=True)
-    d_s = dest[order]
-    k_s = keys[order]
-    v_s = values[order]
-    counts = jnp.bincount(d_s, length=w)
-    starts = jnp.cumsum(counts) - counts
-    pos = jnp.arange(n) - starts[d_s]
-    ok = pos < cap
-    pos_c = jnp.minimum(pos, cap - 1)
-    okf = ok.astype(v_s.dtype).reshape((n,) + (1,) * (v_s.ndim - 1))
-    # valid positions are unique → masked scatter-add == set; overflow rows
-    # clamp to the last slot but add zeros
-    buf_k = jnp.zeros((w, cap), keys.dtype).at[d_s, pos_c].add(k_s * ok)
-    buf_v = jnp.zeros((w, cap) + v_s.shape[1:], v_s.dtype
-                      ).at[d_s, pos_c].add(v_s * okf)
-    buf_m = jnp.zeros((w, cap), jnp.float32).at[d_s, pos_c].add(
-        ok.astype(jnp.float32))
-    overflow = jax.lax.psum(jnp.sum(~ok), axis_name)
-
-    # chunk j of worker i → slot i of worker j (the regroup dispatch)
-    rk = jax.lax.all_to_all(buf_k, axis_name, split_axis=0, concat_axis=0)
-    rv = jax.lax.all_to_all(buf_v, axis_name, split_axis=0, concat_axis=0)
-    rm = jax.lax.all_to_all(buf_m, axis_name, split_axis=0, concat_axis=0)
-
+    (rk, rv), rm, overflow, _ = bucket_route(dest, cap, (keys, values),
+                                             axis_name=axis_name)
     wid = jax.lax.axis_index(axis_name)
     lk = (rk - wid * kpw).reshape(-1)
     lk = jnp.where(rm.reshape(-1) > 0, lk, kpw)     # invalid → drop segment

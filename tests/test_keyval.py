@@ -1,0 +1,180 @@
+"""Typed KV-table tests (reference: keyval/ Key2ValKVTable + typed variants
+with per-value combiners — Int2Int/Long2Double family)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from harp_tpu import combiner as cb
+from harp_tpu import keyval as kv
+
+W = 8
+
+
+def test_kv_merge_combines_like_a_dict(rng):
+    store = kv.kv_empty(64, val_shape=(), val_dtype=jnp.float32)
+    keys = rng.integers(0, 40, 100).astype(np.int32)
+    vals = rng.normal(size=100).astype(np.float32)
+    store, ovf = kv.kv_merge(store, jnp.asarray(keys), jnp.asarray(vals))
+    assert int(ovf) == 0
+    ref = {}
+    for k_, v_ in zip(keys, vals):
+        ref[int(k_)] = ref.get(int(k_), 0.0) + float(v_)
+    assert int(store.count) == len(ref)
+    got_v, got_f = kv.kv_lookup(store, jnp.arange(40))
+    for k_ in range(40):
+        if k_ in ref:
+            assert bool(got_f[k_])
+            np.testing.assert_allclose(float(got_v[k_]), ref[k_], rtol=1e-5)
+        else:
+            assert not bool(got_f[k_])
+
+    # second merge combines with existing entries (add-with-combiner)
+    store, ovf = kv.kv_merge(store, jnp.asarray(keys[:10]),
+                             jnp.asarray(vals[:10]))
+    got_v, _ = kv.kv_lookup(store, jnp.asarray(keys[:10]))
+    for i in range(10):
+        expect = ref[int(keys[i])] + sum(
+            float(vals[j]) for j in range(10) if keys[j] == keys[i])
+        np.testing.assert_allclose(float(got_v[i]), expect, rtol=1e-5)
+
+
+def test_kv_merge_max_min_and_masks(rng):
+    for comb, npop in ((cb.MAX, np.maximum), (cb.MIN, np.minimum)):
+        store = kv.kv_empty(32, val_dtype=jnp.float32)
+        keys = np.array([3, 7, 3, 7, 3], np.int32)
+        vals = np.array([1.0, -2.0, 5.0, -8.0, 2.0], np.float32)
+        mask = np.array([True, True, True, True, False])
+        store, _ = kv.kv_merge(store, jnp.asarray(keys), jnp.asarray(vals),
+                               comb, mask=jnp.asarray(mask))
+        got, found = kv.kv_lookup(store, jnp.asarray([3, 7, 9]), default=-1.0)
+        assert float(got[0]) == npop.reduce([1.0, 5.0])
+        assert float(got[1]) == npop.reduce([-2.0, -8.0])
+        assert float(got[2]) == -1.0 and not bool(found[2])
+
+
+def test_kv_merge_overflow_counted():
+    store = kv.kv_empty(4, val_dtype=jnp.float32)
+    keys = jnp.arange(10, dtype=jnp.int32)
+    vals = jnp.ones(10, jnp.float32)
+    store, ovf = kv.kv_merge(store, keys, vals)
+    assert int(ovf) == 6                      # largest 6 keys dropped
+    got, found = kv.kv_lookup(store, jnp.arange(10))
+    assert bool(np.all(np.asarray(found[:4])))
+    assert not bool(np.any(np.asarray(found[4:])))
+
+
+def test_kv_vector_values(rng):
+    store = kv.kv_empty(16, val_shape=(3,), val_dtype=jnp.float32)
+    keys = np.array([5, 5, 2], np.int32)
+    vals = rng.normal(size=(3, 3)).astype(np.float32)
+    store, _ = kv.kv_merge(store, jnp.asarray(keys), jnp.asarray(vals))
+    got, _ = kv.kv_lookup(store, jnp.asarray([5, 2]))
+    np.testing.assert_allclose(np.asarray(got[0]), vals[0] + vals[1],
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(got[1]), vals[2], rtol=1e-5)
+
+
+def test_distributed_kv_update_and_lookup(session, rng):
+    n_local = 16
+    keys = rng.integers(0, 200, size=(W, n_local)).astype(np.int32)
+    vals = rng.normal(size=(W, n_local)).astype(np.float32)
+
+    def prog(k, v, q):
+        table = kv.DistributedKV(kv.kv_empty(128, val_dtype=jnp.float32))
+        table, r_ovf, s_ovf = table.update(k[0], v[0], route_cap=n_local)
+        out, found = table.lookup(q[0], default=0.0, route_cap=64)
+        return out[None], found[None], r_ovf, s_ovf
+
+    queries = np.broadcast_to(np.arange(64, dtype=np.int32), (W, 64)).copy()
+    out, found, r_ovf, s_ovf = session.spmd(
+        prog,
+        in_specs=(session.shard(), session.shard(), session.shard()),
+        out_specs=(session.shard(), session.shard(), session.replicate(),
+                   session.replicate()))(keys, vals, queries)
+    assert int(r_ovf) == 0 and int(s_ovf) == 0
+    ref = {}
+    for k_, v_ in zip(keys.reshape(-1), vals.reshape(-1)):
+        ref[int(k_)] = ref.get(int(k_), 0.0) + float(v_)
+    out = np.asarray(out)
+    found = np.asarray(found)
+    for w in range(W):
+        for q in range(64):
+            if q in ref:
+                assert found[w, q], (w, q)
+                np.testing.assert_allclose(out[w, q], ref[q], rtol=1e-4)
+            else:
+                assert not found[w, q]
+
+
+def test_distributed_kv_lookup_under_capacity_pressure(session, rng):
+    """Capacity-dropped queries must come back (default, False) and the
+    surviving answers must land on the RIGHT records (route_back restores
+    original order for both values and flags)."""
+    # every query targets owner 0, so route_cap=2 drops most queries
+    keys = np.zeros((W, 8), np.int32)          # key 0 → owner 0
+    keys[:, 1] = 8                             # also owner 0 (8 % 8 == 0)
+    vals = np.ones((W, 8), np.float32)
+    queries = np.zeros((W, 6), np.int32)
+    queries[:, 0] = 8                          # known key
+    queries[:, 1] = 16                         # absent key (owner 0)
+
+    def prog(k, v, q):
+        table = kv.DistributedKV(kv.kv_empty(64, val_dtype=jnp.float32))
+        table, _, _ = table.update(k[0], v[0], route_cap=64)
+        out, found = table.lookup(q[0], default=-5.0, route_cap=2)
+        return out[None], found[None]
+
+    out, found = session.spmd(
+        prog, in_specs=(session.shard(),) * 3,
+        out_specs=(session.shard(), session.shard()))(keys, vals, queries)
+    out, found = np.asarray(out), np.asarray(found)
+    for w in range(W):
+        # exactly 2 queries per worker survived the route_cap
+        assert found[w].sum() <= 2
+        # the first surviving query is the known key with the right value
+        assert found[w, 0] and out[w, 0] == W * 1.0
+        # absent key that survived routing reports not-found with default
+        assert not found[w, 1] and out[w, 1] == -5.0
+        # dropped queries come back (default, False) — never stale values
+        assert np.all(out[w][~found[w]] == -5.0)
+
+
+def test_distributed_kv_masked_padding_consumes_no_capacity(session):
+    """Padding rows (mask=False) must not occupy worker-0 route slots."""
+    n_local = 16
+    keys = np.full((W, n_local), 7, np.int32)   # real key 7 (owner 7)
+    mask = np.zeros((W, n_local), bool)
+    mask[:, 0] = True                           # one real record per worker
+    vals = np.ones((W, n_local), np.float32)
+
+    def prog(k, v, m):
+        table = kv.DistributedKV(kv.kv_empty(16, val_dtype=jnp.float32))
+        # capacity 1: fits the single real record iff padding is excluded
+        table, r_ovf, s_ovf = table.update(k[0], v[0], route_cap=1,
+                                           mask=m[0])
+        out, found = table.lookup(jnp.asarray([7], jnp.int32))
+        return out[None], found[None], r_ovf, s_ovf
+
+    out, found, r_ovf, s_ovf = session.spmd(
+        prog, in_specs=(session.shard(),) * 3,
+        out_specs=(session.shard(), session.shard(), session.replicate(),
+                   session.replicate()))(keys, vals, mask)
+    assert int(r_ovf) == 0 and int(s_ovf) == 0
+    assert np.all(np.asarray(found))
+    np.testing.assert_allclose(np.asarray(out).reshape(-1), W * 1.0)
+
+
+def test_distributed_kv_reports_store_overflow(session, rng):
+    keys = rng.integers(0, 1000, size=(W, 32)).astype(np.int32)
+    vals = np.ones((W, 32), np.float32)
+
+    def prog(k, v):
+        table = kv.DistributedKV(kv.kv_empty(8, val_dtype=jnp.float32))
+        table, r_ovf, s_ovf = table.update(k[0], v[0], route_cap=64)
+        return r_ovf, s_ovf
+
+    _, s_ovf = session.spmd(
+        prog, in_specs=(session.shard(), session.shard()),
+        out_specs=(session.replicate(), session.replicate()))(keys, vals)
+    assert int(s_ovf) > 0     # 1000 keys over 8 workers x 8 slots must spill
