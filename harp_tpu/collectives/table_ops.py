@@ -209,6 +209,11 @@ def group_by_key(
     raise ValueError(f"group_by_key unsupported for {combiner.op}")
 
 
+def default_route_capacity(n: int, num_workers: int) -> int:
+    """Default per-destination bucket size: 2x a balanced share."""
+    return max(1, 2 * -(-n // num_workers))
+
+
 def bucket_route(dest: jax.Array, capacity: int, payloads,
                  valid: Optional[jax.Array] = None,
                  axis_name: str = WORKERS):
@@ -294,7 +299,7 @@ def group_by_key_sharded(
     w = jax.lax.axis_size(axis_name)
     kpw = -(-num_keys // w)
     n = keys.shape[0]
-    cap = capacity or max(1, 2 * -(-n // w))
+    cap = capacity or default_route_capacity(n, w)
     dest = jnp.minimum(keys // kpw, w - 1)
     (rk, rv), rm, overflow, _ = bucket_route(dest, cap, (keys, values),
                                              axis_name=axis_name)

@@ -23,6 +23,10 @@ structures a TPU cannot run; the TPU-native equivalent here is a
 
 Value dtypes follow the arrays you pass — ``int32``/``float32`` stores give
 the Int2Int / Int2Double / Long2Double family without a class per type.
+KEY SPACE: keys are int32 in ``[0, 2^31 - 2]`` — the int32 maximum is
+reserved as the empty-slot/padding sentinel (a key equal to it is treated as
+padding, and wider int64 keys are truncated by the cast; map them into the
+int32 range first).
 """
 
 from __future__ import annotations
@@ -36,6 +40,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from harp_tpu import combiner as combiner_lib
+from harp_tpu.collectives.table_ops import (bucket_route,
+                                            default_route_capacity,
+                                            route_back)
 from harp_tpu.parallel.mesh import WORKERS
 
 EMPTY = jnp.iinfo(jnp.int32).max     # sentinel key for empty slots
@@ -87,7 +94,8 @@ def kv_merge(store: KVStore, keys: jax.Array, vals: jax.Array,
              ) -> Tuple[KVStore, jax.Array]:
     """Insert-or-combine a batch of records (Key2ValKVTable.add semantics).
 
-    ``mask`` marks valid incoming records (padding rows are ignored). Returns
+    ``mask`` marks valid incoming records (padding rows are ignored; a key
+    equal to the int32-max sentinel is always treated as padding). Returns
     (new store, overflow count) — overflow = live keys beyond capacity after
     the merge; the LARGEST keys are dropped, deterministically.
     """
@@ -152,11 +160,9 @@ class DistributedKV:
         """Route records to their owners and combine into the local stores.
         Returns (new DistributedKV, route_overflow, store_overflow). Masked
         (padding) records are excluded without consuming route capacity."""
-        from harp_tpu.collectives.table_ops import bucket_route
-
         w = jax.lax.axis_size(self.axis_name)
         n = keys.shape[0]
-        cap = route_cap or max(1, 2 * -(-n // w))
+        cap = route_cap or default_route_capacity(n, w)
         k = keys.astype(jnp.int32)
         valid_in = (k != EMPTY) if mask is None else (mask & (k != EMPTY))
         (rk, rv), rm, ovf, _ = bucket_route(
@@ -170,18 +176,18 @@ class DistributedKV:
         return DistributedKV(store, self.axis_name), ovf, \
             jax.lax.psum(s_ovf, self.axis_name)
 
-    def lookup(self, keys, default=0, route_cap: int = 0):
+    def lookup(self, keys, default=0, route_cap: int = 0, mask=None):
         """Distributed get: route queries to owners, answer, route back (one
         all_to_all each way; the found flag rides with the values). Returns
-        (values, found) in the original query order; capacity-dropped queries
-        come back as (default, False)."""
-        from harp_tpu.collectives.table_ops import bucket_route, route_back
-
+        (values, found) in the original query order; capacity-dropped or
+        padding queries (``mask=False`` or the sentinel key) come back as
+        (default, False) without consuming route capacity."""
         w = jax.lax.axis_size(self.axis_name)
         n = keys.shape[0]
-        cap = route_cap or max(1, 2 * -(-n // w))
+        cap = route_cap or default_route_capacity(n, w)
         k = keys.astype(jnp.int32)
-        (rk,), rm, _, routing = bucket_route(k % w, cap, (k,),
+        valid_q = (k != EMPTY) if mask is None else (mask & (k != EMPTY))
+        (rk,), rm, _, routing = bucket_route(k % w, cap, (k,), valid=valid_q,
                                              axis_name=self.axis_name)
         q = jnp.where(rm > 0, rk, EMPTY).reshape(-1)
         vals, found = kv_lookup(self.store, q, default)

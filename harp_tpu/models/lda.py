@@ -62,6 +62,12 @@ class LDAConfig:
     epochs: int = 20
     method: str = "cgs"         # "cgs" (ml/java lda) or "cvb0" (contrib/lda)
     balance: bool = True        # serpentine-LPT word→block assignment
+    wt_access: str = "auto"     # auto | gemm | gather — how tokens read/write
+    #   the word-topic block. "gemm" replaces the per-token row gather and
+    #   segment-sum scatter (row-granularity-bound on TPU, ~4M tokens/s) with
+    #   one-hot matmuls on the MXU (f32 one-hot: counts are integers beyond
+    #   bf16's 8-bit mantissa); costs FLOPs ∝ vocab-block width, so "auto"
+    #   picks gemm only for blocks <= 8192 wide
     minibatches_per_hop: int = 4  # sequential doc-group sub-steps per hop:
     #   fully-parallel draws let every token of a word resample against the
     #   SAME stale word-topic row each round (a word's tokens can never
@@ -131,6 +137,16 @@ class LDA:
         vpb = v_pad // w                      # vocab per block
         nmb = self._effective_minibatches(d_local)
         dg = d_local // nmb
+        if cfg.wt_access not in ("auto", "gemm", "gather"):
+            raise ValueError(f"wt_access must be auto|gemm|gather, got "
+                             f"{cfg.wt_access!r}")
+        # the gemm path materializes a (dg*Lb, vpb) f32 one-hot per sub-step;
+        # auto only takes it when the block is narrow AND that operand is
+        # small (<= 256 MB) — wide blocks or huge doc-groups keep the gather
+        onehot_bytes = dg * lb * vpb * 4
+        use_gemm = (cfg.wt_access == "gemm"
+                    or (cfg.wt_access == "auto" and vpb <= 8192
+                        and onehot_bytes <= 256 * 1024 * 1024))
 
         def fit_fn(docs_b, mask_b, z0, wt_block0, seed):
             # docs_b/mask_b/z0: (D_local, W, Lb) — tokens pre-bucketed by home
@@ -148,7 +164,12 @@ class LDA:
                     cur = (jax.nn.one_hot(zs_g, k, dtype=jnp.float32)
                            * ms_g[..., None])
                 nd = dt_g[:, None, :] - cur                   # exclude self
-                nw = wt_block[wl_g] - cur
+                if use_gemm:
+                    oh = jax.nn.one_hot(wl_g.reshape(-1), vpb,
+                                        dtype=jnp.float32)   # (dg*Lb, vpb)
+                    nw = (oh @ wt_block).reshape(cur.shape) - cur
+                else:
+                    nw = wt_block[wl_g] - cur
                 nk = tt_local[None, None, :] - cur
                 logits = (jnp.log(jnp.maximum(nd + cfg.alpha, 1e-10))
                           + jnp.log(jnp.maximum(nw + cfg.beta, 1e-10))
@@ -166,8 +187,14 @@ class LDA:
                     new = (jax.nn.one_hot(zs_new, k, dtype=jnp.float32)
                            * ms_g[..., None])
                 delta = new - cur                             # (dg, Lb, K)
-                wt_block = wt_block + jax.ops.segment_sum(
-                    delta.reshape(-1, k), wl_g.reshape(-1), num_segments=vpb)
+                if use_gemm:
+                    wt_block = wt_block + jax.lax.dot_general(
+                        oh, delta.reshape(-1, k), (((0,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+                else:
+                    wt_block = wt_block + jax.ops.segment_sum(
+                        delta.reshape(-1, k), wl_g.reshape(-1),
+                        num_segments=vpb)
                 d_k = delta.sum(axis=(0, 1))
                 return (wt_block, tt_local + d_k, d_k, key,
                         zs_new, dt_g + delta.sum(axis=1))

@@ -9,6 +9,12 @@ VMEM, and accumulates (K, D) sums / (K,) counts in-place across grid steps.
 
 Falls back transparently to the XLA path (ops/distance.py) on backends without
 pallas TPU lowering; on CPU tests run the kernel in interpret mode.
+
+Measured (v5e chip, K-means n=1M k=100 d=100, 200 in-program iterations):
+the fused kernel ties the XLA path (919 vs 925 iters/s) — XLA's own fusion of
+the two MXU matmuls + argmin already holds the working set in VMEM at these
+shapes, so the kernel stays OPT-IN (HARP_USE_PALLAS=1) as a template for ops
+the autofuser genuinely can't produce rather than a default win.
 """
 
 from __future__ import annotations

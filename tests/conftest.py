@@ -36,3 +36,9 @@ def session():
 @pytest.fixture()
 def rng():
     return np.random.default_rng(42)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "large: larger-scale behavior tests (~1 min total); "
+        "deselect with -m 'not large'")
