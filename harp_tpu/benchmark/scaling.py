@@ -22,7 +22,8 @@ import sys
 import time
 
 
-def measure(widths=(1, 2, 4, 8), n=65536, d=64, k=64, iters=20) -> dict:
+def measure(widths=(1, 2, 4, 8), n=65536, d=64, k=64, iters=20,
+            include_collectives: bool = True) -> dict:
     import jax
 
     import numpy as np
@@ -64,15 +65,17 @@ def measure(widths=(1, 2, 4, 8), n=65536, d=64, k=64, iters=20) -> dict:
                 "requires multi-chip hardware",
     }
 
-    sess8 = HarpSession(num_workers=max(widths),
-                        devices=jax.devices()[:max(widths)])
     coll = {}
-    for r in bench_collectives(sess8, sizes_kb=[1024], loops=20,
-                               ops=("allreduce", "allgather", "reduce_scatter",
-                                    "rotate", "all_to_all")):
-        coll[r.op] = {"size_bytes": r.size_bytes,
-                      "us_per_op": round(r.us_per_op, 1),
-                      "gbps": round(r.gbps, 2)}
+    if include_collectives:
+        sess8 = HarpSession(num_workers=max(widths),
+                            devices=jax.devices()[:max(widths)])
+        for r in bench_collectives(sess8, sizes_kb=[1024], loops=20,
+                                   ops=("allreduce", "allgather",
+                                        "reduce_scatter", "rotate",
+                                        "all_to_all")):
+            coll[r.op] = {"size_bytes": r.size_bytes,
+                          "us_per_op": round(r.us_per_op, 1),
+                          "gbps": round(r.gbps, 2)}
     return {"scaling_efficiency": scaling, "collectives": coll}
 
 

@@ -6,10 +6,18 @@ KMeansCollectiveMapper.java:201-209) and restart means rerunning from iteration
 0. This module adds real periodic checkpoint/resume on orbax (with a plain-numpy
 fallback when orbax is unavailable), flagged as an upgrade.
 
+``async_save=True`` overlaps the disk write with training: ``save`` takes the
+device→host snapshot synchronously (a consistent cut) and hands the
+serialization to a background thread, keeping at most one write in flight —
+``wait()`` (or the next save/restore) joins it. A failed background write
+re-raises on that join, never silently.
+
 Usage::
 
-    ckpt = Checkpointer(dir)
-    ckpt.save(step, {"centroids": cen, "opt": opt_state})
+    ckpt = Checkpointer(dir, async_save=True)
+    ckpt.save(step, {"centroids": cen, "opt": opt_state})   # returns fast
+    ...train next epochs...
+    ckpt.wait()                            # join the in-flight write
     state = ckpt.restore_latest()          # None if no checkpoint
 """
 
@@ -33,19 +41,31 @@ except Exception:      # pragma: no cover - baked-in image has orbax
 class Checkpointer:
     """Step-indexed pytree checkpoints with keep-last-N retention."""
 
-    def __init__(self, directory: str, keep: int = 3, use_orbax: bool = True):
+    def __init__(self, directory: str, keep: int = 3, use_orbax: bool = True,
+                 async_save: bool = False):
         self.directory = os.path.abspath(directory)
         self.keep = keep
         self.use_orbax = use_orbax and _HAVE_ORBAX
         os.makedirs(self.directory, exist_ok=True)
         if self.use_orbax:
             self._ckptr = _ocp.PyTreeCheckpointer()
+        self._executor = None
+        self._pending = None
+        if async_save:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="harp-ckpt")
 
     # -- paths ---------------------------------------------------------------
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step:012d}")
 
     def steps(self) -> list:
+        self.wait()          # a just-saved checkpoint must be visible
+        return self._list_steps()
+
+    def _list_steps(self) -> list:
         out = []
         for name in os.listdir(self.directory):
             m = re.fullmatch(r"step_(\d+)", name)
@@ -55,9 +75,26 @@ class Checkpointer:
 
     # -- save / restore ------------------------------------------------------
     def save(self, step: int, state: Any) -> str:
-        """Save a pytree of arrays; prunes to the newest ``keep`` checkpoints."""
+        """Save a pytree of arrays; prunes to the newest ``keep`` checkpoints.
+
+        With ``async_save`` the device→host snapshot happens here (consistent
+        cut) and the disk write runs on the background thread."""
         path = self._step_dir(step)
-        state = jax.tree.map(np.asarray, state)
+        state = jax.tree.map(np.asarray, state)    # D2H snapshot
+        if self._executor is not None:
+            self.wait()                            # one write in flight
+            self._pending = self._executor.submit(self._write, path, state)
+        else:
+            self._write(path, state)
+        return path
+
+    def wait(self) -> None:
+        """Join any in-flight background write (re-raises its error)."""
+        if self._pending is not None:
+            pending, self._pending = self._pending, None
+            pending.result()
+
+    def _write(self, path: str, state: Any) -> None:
         if self.use_orbax:
             self._ckptr.save(path, state, force=True)
         else:
@@ -68,9 +105,9 @@ class Checkpointer:
             np.savez(os.path.join(path, "arrays.npz"),
                      **{str(i): leaf for i, leaf in enumerate(leaves)})
         self._prune()
-        return path
 
     def restore(self, step: int, like: Optional[Any] = None) -> Any:
+        self.wait()
         path = self._step_dir(step)
         if self.use_orbax:
             if like is not None:
@@ -102,8 +139,10 @@ class Checkpointer:
         return steps[-1] if steps else None
 
     def _prune(self) -> None:
+        # runs on the writer thread under async_save — must NOT call steps()
+        # (its wait() would join the writer's own in-flight future: deadlock)
         import shutil
 
-        steps = self.steps()
+        steps = self._list_steps()
         for s in steps[: max(0, len(steps) - self.keep)]:
             shutil.rmtree(self._step_dir(s), ignore_errors=True)
