@@ -692,6 +692,8 @@ class SGDMF:
             if (epoch + 1) % save_every == 0 or epoch + 1 == epochs:
                 checkpointer.save(epoch + 1, {"w": np.asarray(w_cur),
                                               "h": np.asarray(h_cur)})
+        if hasattr(checkpointer, "wait"):
+            checkpointer.wait()     # surface a failed async final write
         w_final, h_final = self._finalize(w_cur, h_cur, meta)
         return w_final, h_final, np.asarray(rmses), start
 

@@ -38,7 +38,14 @@ def initialize(
     from the environment; on CPU/GPU clusters pass them explicitly (they play the
     role of Harp's nodes/tasks files).
     """
+    # the gang env written by parallel.launch (the depl/ nodes-file
+    # launcher) plays the role of Harp's <jobID>/tasks file: each value is
+    # adopted independently, only where the caller left the parameter None
     coordinator_address = coordinator_address or os.environ.get("HARP_COORDINATOR")
+    if num_processes is None and "HARP_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["HARP_NUM_PROCESSES"])
+    if process_id is None and "HARP_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["HARP_PROCESS_ID"])
     if coordinator_address is None and num_processes is None:
         # Single host or auto-detectable TPU pod environment.
         if os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):

@@ -1,0 +1,153 @@
+"""Nodes-file gang launcher — the ``depl/`` + ``Driver`` surface, TPU-native.
+
+Reference parity: the no-Hadoop harness launched one JVM per worker over ssh
+from a ``nodes`` file (``#rackID`` headers + one hostname per line;
+depl/Depl.java:36, nodes parsing :45; collective/Driver.java:93
+startAllWorkers:203; worker/Nodes.java:37 parsed the same file for
+membership). Here::
+
+    python -m harp_tpu.parallel.launch nodes.txt -- python train.py
+
+parses the same file format, assigns process ids in file order, picks the
+first node as the jax.distributed coordinator (the master — Harp: min worker
+id), and launches the command once per node with the gang environment set:
+
+    HARP_COORDINATOR=<first-host>:<port>  HARP_NUM_PROCESSES=<n>
+    HARP_PROCESS_ID=<i>  HARP_RACK=<rack>
+
+The launched program calls ``harp_tpu.parallel.distributed.initialize()``
+(which reads HARP_COORDINATOR) to join. Local hostnames (localhost/127.0.0.1)
+spawn subprocesses; remote hostnames go through ``ssh`` — same split as the
+reference's Depl. ``--smoke`` runs the mp_smoke routine instead of a user
+command (the Driver.java standalone-test mode).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import subprocess
+import sys
+from typing import List, Optional, Sequence, Tuple
+
+LOCAL_HOSTS = ("localhost", "127.0.0.1", "::1")
+
+
+@dataclasses.dataclass(frozen=True)
+class Node:
+    host: str
+    rack: int
+
+
+def parse_nodes_file(path: str) -> List[Node]:
+    """Parse the reference's nodes format: ``#<rackID>`` headers, one
+    hostname per following line (worker/Nodes.java:37; test fixture
+    core/harp-collective/src/test/resources/test_nodes)."""
+    nodes: List[Node] = []
+    rack = 0
+    with open(path) as f:
+        for raw in f:
+            line = raw.strip()
+            if not line:
+                continue
+            if line.startswith("#"):
+                rack = int(line[1:])
+                continue
+            nodes.append(Node(line, rack))
+    if not nodes:
+        raise ValueError(f"no worker hosts in nodes file {path}")
+    return nodes
+
+
+def gang_env(nodes: Sequence[Node], process_id: int, port: int) -> dict:
+    return {
+        "HARP_COORDINATOR": f"{nodes[0].host}:{port}",
+        "HARP_NUM_PROCESSES": str(len(nodes)),
+        "HARP_PROCESS_ID": str(process_id),
+        "HARP_RACK": str(nodes[process_id].rack),
+    }
+
+
+def _spawn(node: Node, env: dict, command: List[str]) -> subprocess.Popen:
+    if node.host in LOCAL_HOSTS:
+        return subprocess.Popen(command, env={**os.environ, **env},
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+    # remote: same role as Depl.executeCMDandReturn:54 — env rides the ssh
+    # command line since ssh does not forward arbitrary variables
+    exports = " ".join(f"{k}={v}" for k, v in env.items())
+    remote = f"cd {os.getcwd()} && {exports} " + " ".join(command)
+    return subprocess.Popen(["ssh", "-o", "BatchMode=yes", node.host, remote],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+
+
+def launch(nodes: Sequence[Node], command: List[str], port: int = 0,
+           timeout: Optional[float] = 1800.0) -> List[Tuple[int, str]]:
+    """Launch ``command`` once per node with the gang env; wait for all.
+
+    Returns [(returncode, combined output)] in node order; kills the rest of
+    the gang if any member fails (fail-stop — the reference's gang allocator
+    never re-executed workers, SURVEY §5). The 1800 s default timeout mirrors
+    DATA_MAX_WAIT_TIME (io/Constant.java:36)."""
+    if port == 0:
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+    procs = [_spawn(node, gang_env(nodes, i, port), command)
+             for i, node in enumerate(nodes)]
+    results: List[Tuple[int, str]] = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            results.append((p.returncode, out))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return results
+
+
+def smoke_command() -> List[str]:
+    """The per-node command for --smoke mode: run the mp_smoke routine with
+    the slot read from the gang env (Driver.java standalone-test mode)."""
+    return [sys.executable, "-c",
+            "import os; from harp_tpu.parallel import mp_smoke; "
+            "mp_smoke.run(int(os.environ['HARP_PROCESS_ID']), "
+            "int(os.environ['HARP_NUM_PROCESSES']), "
+            "int(os.environ['HARP_COORDINATOR'].rsplit(':', 1)[1]))"]
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    nodes_path = argv[0]
+    rest = argv[1:]
+    smoke = "--smoke" in rest
+    if smoke:
+        rest.remove("--smoke")
+    if rest and rest[0] == "--":
+        rest = rest[1:]
+    nodes = parse_nodes_file(nodes_path)
+    if smoke:
+        rest = smoke_command()
+    elif not rest:
+        print("no command given (use -- <command...> or --smoke)",
+              file=sys.stderr)
+        return 2
+    results = launch(nodes, rest)
+    ok = True
+    for i, (rc, out) in enumerate(results):
+        print(f"--- node {i} ({nodes[i].host}, rack {nodes[i].rack}) "
+              f"rc={rc} ---")
+        print(out, end="" if out.endswith("\n") else "\n")
+        ok = ok and rc == 0
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

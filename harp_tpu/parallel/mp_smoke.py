@@ -36,13 +36,17 @@ def run(process_id: int, num_processes: int, port: int,
         devices_per_process: int = 4) -> None:
     # Virtual CPU devices must be requested before the backend initializes;
     # the image's sitecustomize force-selects the TPU backend via jax.config,
-    # so override it back the same way (see tests/conftest.py).
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags +
-            f" --xla_force_host_platform_device_count={devices_per_process}"
-        ).strip()
+    # so override it back the same way (see tests/conftest.py). An inherited
+    # device-count flag (e.g. the test parent's 8) is REPLACED — this process
+    # must own exactly its devices_per_process share of the gang.
+    import re
+
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (
+        flags +
+        f" --xla_force_host_platform_device_count={devices_per_process}"
+    ).strip()
     import jax
 
     jax.config.update("jax_platforms", "cpu")

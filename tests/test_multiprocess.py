@@ -25,3 +25,25 @@ def test_two_process_gang_runs_collectives_and_kmeans():
     outs = mp_smoke.spawn_gang(num_processes=2, devices_per_process=4,
                                repo_root=REPO)
     assert len(outs) == 2
+
+
+def test_nodes_file_launcher_runs_the_gang(tmp_path):
+    """The depl/ nodes-file launcher: parse the reference's format (#rack
+    headers + hostnames — the test_nodes fixture shape), launch one process
+    per node with the gang env, and run the full smoke routine."""
+    from harp_tpu.parallel import launch
+
+    nodes_file = tmp_path / "nodes"
+    nodes_file.write_text("#0\nlocalhost\n#1\n127.0.0.1\n")
+    nodes = launch.parse_nodes_file(str(nodes_file))
+    assert [n.rack for n in nodes] == [0, 1]
+    assert len(nodes) == 2
+
+    old = os.getcwd()
+    os.chdir(REPO)
+    try:
+        # the real entry point's --smoke branch (Driver standalone-test mode)
+        rc = launch.main([str(nodes_file), "--smoke"])
+    finally:
+        os.chdir(old)
+    assert rc == 0
