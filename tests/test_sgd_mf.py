@@ -7,6 +7,8 @@ a low-rank signal, not a bitwise trajectory.
 
 import dataclasses
 
+import pytest
+
 import numpy as np
 
 from harp_tpu.io import datagen
@@ -187,13 +189,15 @@ def test_fit_checkpointed_resume_matches_uninterrupted(session, tmp_path):
     np.testing.assert_array_equal(w_c, w_a)
 
 
-def test_sgd_mf_two_slice_pipeline_converges(session):
+@pytest.mark.parametrize("layout", ["dense", "sparse"])
+def test_sgd_mf_two_slice_pipeline_converges(session, layout):
     """numModelSlices=2 parity: double-buffered rotation (dymoro pipeline)
-    converges like the single-slice schedule."""
+    converges like the single-slice schedule — on BOTH data layouts."""
     rows, cols, vals = datagen.sparse_ratings(
         num_users=96, num_items=80, rank=4, density=0.25, seed=3, noise=0.01)
     cfg = sgd_mf.SGDMFConfig(rank=8, lam=0.01, lr=0.08, epochs=20,
-                             minibatches_per_hop=4, num_slices=2)
+                             minibatches_per_hop=4, num_slices=2,
+                             layout=layout)
     w_f, h_f, rmse = sgd_mf.SGDMF(session, cfg).fit(rows, cols, vals, 96, 80)
     assert rmse[-1] < 0.25 * rmse[0]
     assert sgd_mf.numpy_rmse(w_f, h_f, rows, cols, vals) < 0.12
