@@ -12,12 +12,18 @@ framework re-expresses those capabilities idiomatically for TPU:
 * dymoro model rotation              → :mod:`harp_tpu.collectives.rotation`.
 * Intel DAAL kernels                 → :mod:`harp_tpu.ops` (jnp + pallas) and
   :mod:`harp_tpu.models` (the algorithm library).
-* YARN gang scheduling               → :mod:`harp_tpu.parallel.distributed`.
+* keyval/ typed KV tables            → :mod:`harp_tpu.keyval` (sorted dense
+  stores + :class:`harp_tpu.keyval.DistributedKV`).
+* YARN gang scheduling               → :mod:`harp_tpu.parallel.distributed`
+  (+ :mod:`harp_tpu.parallel.launch` nodes-file launcher).
+* per-algorithm CLI launchers        → ``python -m harp_tpu.run <algo>``.
 
-See SURVEY.md at the repo root for the full reference analysis and mapping.
+See SURVEY.md at the repo root for the full reference analysis and mapping;
+MIGRATION.md for the Harp-user cookbook; PERF.md for measured performance.
 """
 
 from harp_tpu import combiner
+from harp_tpu import keyval
 from harp_tpu import partitioner
 from harp_tpu.combiner import AVG, MAX, MIN, MINUS, MULTIPLY, SUM, Combiner, Op
 from harp_tpu.parallel.mesh import MODEL, WORKERS, force_host_devices, make_mesh
@@ -30,5 +36,5 @@ __all__ = [
     "AVG", "MAX", "MIN", "MINUS", "MULTIPLY", "SUM",
     "Combiner", "Op", "Dist", "Table", "HarpSession",
     "WORKERS", "MODEL", "force_host_devices", "make_mesh",
-    "combiner", "partitioner",
+    "combiner", "keyval", "partitioner",
 ]
