@@ -279,13 +279,11 @@ class LDA:
                        sess.replicate()),
         )
 
-    def fit(self, docs: np.ndarray, seed: int = 0
-            ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """Train on a (num_docs, doc_len) token matrix.
+    def prepare(self, docs: np.ndarray, seed: int = 0):
+        """Bucketize + place tokens and initial counts on the mesh ONCE.
 
-        Returns (doc_topic (D, K), word_topic (V, K), log-likelihood per epoch
-        in the reference formula).
-        """
+        Returns an opaque state for :meth:`fit_prepared` — keeps host layout
+        and H2D transfer out of timed regions (KMeans.prepare idiom)."""
         sess, cfg = self.session, self.config
         w = sess.num_workers
         vpb = -(-cfg.vocab // w)
@@ -337,16 +335,31 @@ class LDA:
         key = (w, v_pad, lb, num_docs, cfg.method)
         if key not in self._fns:
             self._fns[key] = self._build(w, v_pad, lb, num_docs // w)
-        doc_topic, wt_out, z, ll = self._fns[key](
-            sess.scatter(jnp.asarray(docs_b, jnp.int32)),
-            sess.scatter(jnp.asarray(mask_b, jnp.float32)),
-            sess.scatter(jnp.asarray(z0)),
-            sess.scatter(jnp.asarray(wt)),
-            jnp.asarray(seed, jnp.int32))
+        return (key,
+                (sess.scatter(jnp.asarray(docs_b, jnp.int32)),
+                 sess.scatter(jnp.asarray(mask_b, jnp.float32)),
+                 sess.scatter(jnp.asarray(z0)),
+                 sess.scatter(jnp.asarray(wt))),
+                jnp.asarray(seed, jnp.int32),
+                (word_block, word_slot, vpb))
+
+    def fit_prepared(self, state
+                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Run training on already-placed device data (no host prep)."""
+        key, data, seed, (word_block, word_slot, vpb) = state
+        doc_topic, wt_out, z, ll = self._fns[key](*data, seed)
         # un-permute word rows back to original vocab ids
         wt_out = np.asarray(wt_out)
         wt_final = wt_out[word_block.astype(np.int64) * vpb + word_slot]
         return np.asarray(doc_topic), wt_final, np.asarray(ll)
+
+    def fit(self, docs: np.ndarray, seed: int = 0
+            ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Train on a (num_docs, doc_len) token matrix.
+
+        Returns (doc_topic (D, K), word_topic (V, K), log-likelihood per epoch
+        in the reference formula)."""
+        return self.fit_prepared(self.prepare(docs, seed))
 
 
 # --------------------------------------------------------------------------- #

