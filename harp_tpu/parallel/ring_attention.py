@@ -90,6 +90,19 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return num / jnp.maximum(den, 1e-30)[:, None]
 
 
+def ring_attention_mha(q: jax.Array, k: jax.Array, v: jax.Array,
+                       causal: bool = False, axis_name: str = WORKERS
+                       ) -> jax.Array:
+    """Multi-head ring attention: q/k/v (L/W, H, Dh), heads vmapped over the
+    single-head kernel (one ppermute ring per step carries all heads — the
+    vmap is inside the rotation, so collectives do not multiply). Drop-in
+    peer of :func:`ulysses_attention` for the sequence-sharded layout."""
+    per_head = jax.vmap(
+        lambda qh, kh, vh: ring_attention(qh, kh, vh, causal, axis_name),
+        in_axes=1, out_axes=1)
+    return per_head(q, k, v)
+
+
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       num_heads: int, causal: bool = False,
                       axis_name: str = WORKERS) -> jax.Array:

@@ -140,6 +140,33 @@ def test_ring_attention_matches_reference(session):
                                    rtol=2e-3, atol=2e-3)
 
 
+def test_ring_attention_mha_matches_ulysses_and_reference(session):
+    """The two SP layouts compute the SAME attention: multi-head ring vs
+    Ulysses vs the replicated per-head reference."""
+    rng = np.random.default_rng(13)
+    l, h, dh = 64, 8, 8
+    q = rng.standard_normal((l, h, dh)).astype(np.float32)
+    k = rng.standard_normal((l, h, dh)).astype(np.float32)
+    v = rng.standard_normal((l, h, dh)).astype(np.float32)
+    ring = session.run(
+        lambda a, b, c: ring_attention.ring_attention_mha(a, b, c, True),
+        session.scatter(jnp.asarray(q)), session.scatter(jnp.asarray(k)),
+        session.scatter(jnp.asarray(v)),
+        in_specs=(session.shard(),) * 3, out_specs=session.shard())
+    uly = session.run(
+        lambda a, b, c: ring_attention.ulysses_attention(a, b, c, h, True),
+        session.scatter(jnp.asarray(q)), session.scatter(jnp.asarray(k)),
+        session.scatter(jnp.asarray(v)),
+        in_specs=(session.shard(),) * 3, out_specs=session.shard())
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(uly),
+                               rtol=2e-3, atol=2e-3)
+    ref = np.stack([
+        np.asarray(ring_attention.reference_attention(
+            jnp.asarray(q[:, i]), jnp.asarray(k[:, i]), jnp.asarray(v[:, i]),
+            True)) for i in range(h)], axis=1)
+    np.testing.assert_allclose(np.asarray(ring), ref, rtol=2e-3, atol=2e-3)
+
+
 def test_ulysses_attention_matches_reference(session):
     rng = np.random.default_rng(9)
     l, h, dh = 64, 8, 8
