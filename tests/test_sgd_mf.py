@@ -217,3 +217,21 @@ def test_sgd_mf_two_slice_covers_every_rating(session):
     _, _, _, mask, _, _ = sgd_mf.bucketize(rows, cols, vals, 8, 64, 64, 2,
                                            num_col_blocks=16)
     assert int(mask.sum()) == len(vals)
+
+
+def test_nan_ratings_rejected_and_auto_dense_respects_int32_guard(session):
+    """NaN is the dense missing-entry sentinel: NaN input values raise; and
+    auto layout never picks a dense slab the int32 scatter could not index."""
+    rows = np.array([0, 1], np.int32)
+    cols = np.array([0, 1], np.int32)
+    vals = np.array([1.0, np.nan], np.float32)
+    m = sgd_mf.SGDMF(session, sgd_mf.SGDMFConfig(rank=4, epochs=1))
+    with pytest.raises(ValueError, match="NaN"):
+        m.prepare(rows, cols, vals, 8, 8)
+
+    # a geometry whose slab would exceed 2^31 elements must auto-pick sparse
+    # even under an unlimited byte budget
+    big = sgd_mf.SGDMF(session, sgd_mf.SGDMFConfig(
+        rank=4, epochs=1, dense_max_bytes=1 << 62))
+    assert big._choose_layout(200_000, 200_000) == "sparse"
+    assert big._choose_layout(512, 512) == "dense"
