@@ -22,6 +22,13 @@ TPU-native reformulation (SURVEY §7 "hard parts" — async semantics under SPMD
   between updates); convergence is statistical, not token-sequential.
 * Topic totals n_k are refreshed by psum once per hop — bounded staleness,
   replacing Harp's asynchronously drifting totals.
+* Deviation: the reference splits the word-topic table into numModelSlices=2
+  pipelined slices (LDAMPCollectiveMapper wTableMap[k]); here the rotation is
+  single-slice — the sampler's sequential doc-group sub-steps already fill
+  the hop, and XLA's async collective scheduling overlaps the block ppermute
+  with the next hop's leading compute, which is what the second slice bought
+  the reference (the double-buffered substrate exists in
+  collectives.rotation.pipelined_rotation and is exercised by SGD-MF).
 
 Likelihood monitor: the REFERENCE formula, exactly (CalcLikelihoodTask.run:56 +
 the topic-sum completion in printLikelihood, LDAMPCollectiveMapper.java:731-748
