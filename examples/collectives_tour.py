@@ -88,6 +88,38 @@ def main():
                    in_specs=(sess.shard(),), out_specs=sess.shard())
     print(f"{'worker ids':>18}: {np.asarray(ids).ravel()}")
 
+    # owner-partitioned KV shuffle (GroupByKeyCollective, scalable form)
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 16, size=(w, 8)).astype(np.int32)
+    vals = np.ones((w, 8), np.float32)
+
+    def group_ex(k, v):
+        out, ovf = table_ops.group_by_key_sharded(k[0], v[0], num_keys=16,
+                                                  capacity=16)
+        return out, ovf
+
+    out, ovf = sess.run(group_ex, keys, vals,
+                        in_specs=(sess.shard(), sess.shard()),
+                        out_specs=(rep, rep))
+    print(f"{'group_by_key':>18}: counts per key = "
+          f"{np.asarray(out).astype(int)} (overflow {int(ovf)})")
+
+    # typed KV table (keyval/): routed insert-or-combine + lookup
+    from harp_tpu import keyval as kv
+
+    def kv_ex(k, v):
+        t = kv.DistributedKV(kv.kv_empty(64, val_dtype=jnp.float32))
+        t, _, _ = t.update(k[0], v[0])
+        got, found = t.lookup(jnp.arange(8, dtype=jnp.int32))
+        return got[None], found[None]
+
+    got, found = sess.run(kv_ex, keys, vals,
+                          in_specs=(sess.shard(), sess.shard()),
+                          out_specs=(sess.shard(), sess.shard()))
+    print(f"{'DistributedKV':>18}: keys 0-7 on worker 0 = "
+          f"{np.asarray(got)[0].astype(int)}, found = "
+          f"{np.asarray(found)[0].astype(int)}")
+
 
 if __name__ == "__main__":
     main()
