@@ -83,3 +83,11 @@ def test_run_nn_cli():
                     "--epochs", "3", "--num-classes", "2"])
     assert out.returncode == 0, out.stderr[-2000:]
     assert "train acc" in out.stdout
+
+
+def test_run_sgd_mf_cli_adaptive():
+    out = _run_cmd(["sgd_mf", "--num-users", "128", "--num-items", "96",
+                    "--density", "0.2", "--rank", "8", "--epochs", "6",
+                    "--adaptive"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "tuned budget:" in out.stdout and "M samples/s" in out.stdout
