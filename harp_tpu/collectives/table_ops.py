@@ -221,17 +221,22 @@ def bucket_route(dest: jax.Array, capacity: int, payloads,
 
     Routes each record (one row of every array in ``payloads``) to worker
     ``dest[i]`` through one ``all_to_all`` of static (W, capacity) buckets.
-    ``valid=False`` rows (and any with ``dest >= W``) are excluded without
-    consuming capacity. Returns ``(routed, recv_mask, overflow, routing)``:
+    ``valid=False`` rows and out-of-range destinations (``dest < 0`` or
+    ``dest >= W``) are excluded without consuming capacity. Returns
+    ``(routed, recv_mask, overflow, routing)``:
     ``routed`` mirrors ``payloads`` with shapes (W, capacity, ...);
     ``recv_mask`` marks filled slots; ``overflow`` is the psum'd count of
     VALID records dropped for capacity; ``routing`` feeds
     :func:`route_back`."""
     w = jax.lax.axis_size(axis_name)
     n = dest.shape[0]
-    # invalid records route to a virtual "drop" destination w so they never
-    # consume a real bucket's capacity
-    dest = jnp.where(valid if valid is not None else True, dest, w)
+    # invalid records (valid=False or negative dest) route to a virtual
+    # "drop" destination w so they never consume a real bucket's capacity;
+    # dest >= w is likewise dropped by the ok mask below
+    keep = dest >= 0
+    if valid is not None:
+        keep = keep & valid
+    dest = jnp.where(keep, dest, w)
     order = jnp.argsort(dest, stable=True)
     d_s = dest[order]
     counts = jnp.bincount(d_s, length=w + 1)

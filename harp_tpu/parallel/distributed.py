@@ -23,6 +23,18 @@ import jax
 
 log = logging.getLogger("harp_tpu.distributed")
 
+_gang_watchdog = None
+
+
+def _arm_watchdog() -> None:
+    """Per-member heartbeat: device hang → process exit → launcher fail-stop
+    (parallel.failure.start_gang_watchdog documents the chain)."""
+    global _gang_watchdog
+    from harp_tpu.parallel import failure
+
+    if _gang_watchdog is None:
+        _gang_watchdog = failure.start_gang_watchdog()
+
 
 def initialize(
     coordinator_address: Optional[str] = None,
@@ -52,6 +64,7 @@ def initialize(
             jax.distributed.initialize(initialization_timeout=initialization_timeout_s)
             log.info("joined TPU pod gang: process %d/%d",
                      jax.process_index(), jax.process_count())
+            _arm_watchdog()
         return
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
@@ -61,9 +74,14 @@ def initialize(
     )
     log.info("joined gang at %s: process %d/%d", coordinator_address,
              jax.process_index(), jax.process_count())
+    _arm_watchdog()
 
 
 def shutdown() -> None:
     """Leave the gang (CollectiveMapper teardown :783-788 equivalent)."""
+    global _gang_watchdog
+    if _gang_watchdog is not None:
+        _gang_watchdog.stop()
+        _gang_watchdog = None
     if jax.process_count() > 1:
         jax.distributed.shutdown()

@@ -11,6 +11,8 @@ the watchdog instead of blocking for half an hour.
 
 from __future__ import annotations
 
+import os
+import sys
 import threading
 import time
 from typing import Callable, Optional
@@ -19,6 +21,7 @@ import jax
 import numpy as np
 
 DEFAULT_TIMEOUT_S = 60.0        # vs the reference's 1800 s
+GANG_WATCHDOG_EXIT = 98         # exit code a watchdog fail-stop uses
 
 
 class WorkerFailure(RuntimeError):
@@ -52,10 +55,12 @@ class Watchdog:
 
     def __init__(self, interval_s: float = 10.0,
                  timeout_s: float = DEFAULT_TIMEOUT_S,
-                 on_failure: Optional[Callable[[], None]] = None):
+                 on_failure: Optional[Callable[[], None]] = None,
+                 probe: Optional[Callable[[float], bool]] = None):
         self.interval_s = interval_s
         self.timeout_s = timeout_s
         self.on_failure = on_failure
+        self.probe = probe or probe_devices
         self.failed = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -67,7 +72,7 @@ class Watchdog:
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):
-            if not probe_devices(self.timeout_s):
+            if not self.probe(self.timeout_s):
                 self.failed = True
                 if self.on_failure is not None:
                     self.on_failure()
@@ -89,3 +94,35 @@ class Watchdog:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+
+def start_gang_watchdog(interval_s: Optional[float] = None,
+                        timeout_s: Optional[float] = None
+                        ) -> Optional[Watchdog]:
+    """Start the per-gang-member watchdog (called by
+    ``distributed.initialize`` once the gang is joined).
+
+    Fail-stop chain: a hung/poisoned device misses the heartbeat → this
+    process ``os._exit(GANG_WATCHDOG_EXIT)`` → the gang launcher's poll loop
+    (``parallel.launch.launch``) sees the non-zero exit and kills every other
+    member immediately — the reference's "Slaves may fail" master check
+    (Communication.java:82), but in seconds instead of 1800 s and wired into
+    every gang run rather than only the master barrier.
+
+    Env control: ``HARP_WATCHDOG=0`` disables; ``HARP_WATCHDOG_INTERVAL`` /
+    ``HARP_WATCHDOG_TIMEOUT`` (seconds) override the defaults."""
+    if os.environ.get("HARP_WATCHDOG", "1").lower() in ("0", "false", "off"):
+        return None
+    interval = float(interval_s if interval_s is not None
+                     else os.environ.get("HARP_WATCHDOG_INTERVAL", 10.0))
+    timeout = float(timeout_s if timeout_s is not None
+                    else os.environ.get("HARP_WATCHDOG_TIMEOUT",
+                                        DEFAULT_TIMEOUT_S))
+
+    def _die() -> None:
+        print("harp_tpu.watchdog: device heartbeat missed deadline — "
+              "fail-stop (exit %d)" % GANG_WATCHDOG_EXIT,
+              file=sys.stderr, flush=True)
+        os._exit(GANG_WATCHDOG_EXIT)
+
+    return Watchdog(interval, timeout, on_failure=_die).start()

@@ -26,8 +26,11 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import shlex
 import subprocess
 import sys
+import threading
+import time
 from typing import List, Optional, Sequence, Tuple
 
 LOCAL_HOSTS = ("localhost", "127.0.0.1", "::1")
@@ -74,21 +77,38 @@ def _spawn(node: Node, env: dict, command: List[str]) -> subprocess.Popen:
                                 stdout=subprocess.PIPE,
                                 stderr=subprocess.STDOUT, text=True)
     # remote: same role as Depl.executeCMDandReturn:54 — env rides the ssh
-    # command line since ssh does not forward arbitrary variables
-    exports = " ".join(f"{k}={v}" for k, v in env.items())
-    remote = f"cd {os.getcwd()} && {exports} " + " ".join(command)
-    return subprocess.Popen(["ssh", "-o", "BatchMode=yes", node.host, remote],
+    # command line since ssh does not forward arbitrary variables. -tt forces
+    # a pty so that killing the local ssh client HUPs the remote session:
+    # fail-stop reaches the remote member, not just its local proxy.
+    exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+    remote = (f"cd {shlex.quote(os.getcwd())} && {exports} "
+              + " ".join(shlex.quote(tok) for tok in command))
+    return subprocess.Popen(["ssh", "-tt", "-o", "BatchMode=yes", node.host,
+                             remote],
                             stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True)
 
 
+def _drain(proc: subprocess.Popen, sink: List[str]) -> None:
+    # runs on its own thread so a chatty member can never fill its PIPE and
+    # stall the gang's collectives behind a blocked write; \r stripped
+    # because remote members run under a pty (-tt)
+    for line in proc.stdout:
+        sink.append(line.replace("\r", ""))
+    proc.stdout.close()
+
+
 def launch(nodes: Sequence[Node], command: List[str], port: int = 0,
-           timeout: Optional[float] = 1800.0) -> List[Tuple[int, str]]:
+           timeout: Optional[float] = 1800.0,
+           poll_interval: float = 0.05) -> List[Tuple[int, str]]:
     """Launch ``command`` once per node with the gang env; wait for all.
 
-    Returns [(returncode, combined output)] in node order; kills the rest of
-    the gang if any member fails (fail-stop — the reference's gang allocator
-    never re-executed workers, SURVEY §5). The 1800 s default timeout mirrors
+    Returns [(returncode, combined output)] in node order. Fail-stop: all
+    members are polled concurrently (stdout drained by threads), and the
+    moment any member exits non-zero the rest of the gang is killed — a
+    crashed member never leaves survivors blocked in the jax.distributed
+    rendezvous until the timeout (the reference's gang allocator never
+    re-executed workers, SURVEY §5). The 1800 s default timeout mirrors
     DATA_MAX_WAIT_TIME (io/Constant.java:36)."""
     if port == 0:
         import socket
@@ -98,16 +118,37 @@ def launch(nodes: Sequence[Node], command: List[str], port: int = 0,
             port = s.getsockname()[1]
     procs = [_spawn(node, gang_env(nodes, i, port), command)
              for i, node in enumerate(nodes)]
-    results: List[Tuple[int, str]] = []
+    sinks: List[List[str]] = [[] for _ in procs]
+    drains = [threading.Thread(target=_drain, args=(p, s), daemon=True)
+              for p, s in zip(procs, sinks)]
+    for t in drains:
+        t.start()
+    deadline = None if timeout is None else time.monotonic() + timeout
     try:
-        for p in procs:
-            out, _ = p.communicate(timeout=timeout)
-            results.append((p.returncode, out))
+        pending = set(range(len(procs)))
+        while pending:
+            for i in sorted(pending):
+                rc = procs[i].poll()
+                if rc is None:
+                    continue
+                pending.discard(i)
+                if rc != 0:  # fail-stop: kill the survivors immediately
+                    for j in pending:
+                        procs[j].kill()
+            if pending and deadline is not None and \
+                    time.monotonic() > deadline:
+                for j in pending:
+                    procs[j].kill()
+                raise subprocess.TimeoutExpired(command, timeout)
+            if pending:
+                time.sleep(poll_interval)
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
-    return results
+        for t in drains:
+            t.join(timeout=10.0)
+    return [(p.returncode, "".join(s)) for p, s in zip(procs, sinks)]
 
 
 def smoke_command() -> List[str]:

@@ -419,3 +419,18 @@ class TestGroupByKeySharded:
         out, ovf = self._run(session, keys, vals, 16, cap=2)
         assert int(ovf) == W * 8 - W * 2             # 2 survive per worker
         assert float(np.asarray(out)[0]) == W * 2.0
+
+    def test_negative_keys_dropped_not_misrouted(self, session, rng):
+        # advisor r2: a negative dest used to pass the d_s < w check and land
+        # (clamped) in worker 0's bucket as a phantom delivery; now negatives
+        # route to the virtual drop destination like valid=False rows
+        keys = rng.integers(0, 16, size=(W, 10)).astype(np.int32)
+        keys[:, ::3] = -rng.integers(1, 50, size=keys[:, ::3].shape)
+        vals = np.ones((W, 10), np.float32)
+        out, ovf = self._run(session, keys, vals, 16, cap=16)
+        assert int(ovf) == 0                         # dropped, not overflow
+        ref = np.zeros(16, np.float32)
+        good = keys >= 0
+        np.add.at(ref, keys[good], vals[good])
+        np.testing.assert_allclose(np.asarray(out).reshape(-1), ref,
+                                   rtol=1e-6)
