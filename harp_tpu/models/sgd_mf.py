@@ -608,14 +608,25 @@ class SGDMF:
         h_final = out_h[cb[:num_cols].astype(np.int64) * cpb + cl[:num_cols]]
         return w_final, h_final
 
-    def fit_prepared(self, state) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """Run training on already-placed device data (no host prep)."""
+    def train_prepared(self, state):
+        """Run the compiled training program; factors stay ON DEVICE.
+
+        Returns (w_dev, h_dev, rmse ndarray). The rmse fetch forces execution
+        (tunnel platforms), but the factor blocks (MBs) are not transferred —
+        this is the timing surface benchmarks use: steady-state epoch
+        throughput, not the one-time D2H of the final model (bench.py,
+        PERF.md). :meth:`fit_prepared` adds the fetch + de-permutation."""
         layout, data, w0, h0, meta = state
         key = self._program(layout, self.config.minibatches_per_hop,
                             self.config.epochs, meta[6])
         out_w, out_h, rmse = self._compiled[key](*data, w0, h0)
-        w_final, h_final = self._finalize(out_w, out_h, meta)
-        return w_final, h_final, np.asarray(rmse)
+        return out_w, out_h, np.asarray(rmse)
+
+    def fit_prepared(self, state) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Run training on already-placed device data (no host prep)."""
+        out_w, out_h, rmse = self.train_prepared(state)
+        w_final, h_final = self._finalize(out_w, out_h, state[4])
+        return w_final, h_final, rmse
 
     def fit_adaptive(self, state, tuner: Optional["HopBudgetTuner"] = None,
                      epochs: Optional[int] = None

@@ -58,6 +58,33 @@ def test_implicit_als_ranks_observed_higher(session):
     assert in_block > out_block + 0.2
 
 
+def test_implicit_als_rejects_negative_values(session):
+    # Hu-Koren confidence needs nonnegative counts; a negative value at high
+    # alpha makes the normal equations indefinite → NaN factors (bench r3)
+    rows = np.array([0, 1, 2], np.int32)
+    cols = np.array([0, 1, 2], np.int32)
+    vals = np.array([1.0, -0.5, 1.0], np.float32)
+    cfg = als.ALSConfig(rank=4, iterations=1, implicit=True)
+    with pytest.raises(ValueError, match="nonnegative interaction"):
+        als.ALS(session, cfg).prepare(rows, cols, vals, 8, 8)
+
+
+def test_als_prepare_fit_prepared_matches_fit(session):
+    rng = np.random.default_rng(5)
+    n = 64
+    rows = rng.integers(0, n, 400).astype(np.int32)
+    cols = rng.integers(0, n, 400).astype(np.int32)
+    vals = np.abs(rng.normal(size=400)).astype(np.float32)
+    cfg = als.ALSConfig(rank=4, lam=0.1, alpha=10.0, iterations=3,
+                        implicit=True)
+    m = als.ALS(session, cfg)
+    u1, v1, r1 = m.fit(rows, cols, vals, n, n, seed=2)
+    u2, v2, r2 = m.fit_prepared(m.prepare(rows, cols, vals, n, n, seed=2))
+    np.testing.assert_array_equal(u1, u2)
+    np.testing.assert_array_equal(v1, v2)
+    np.testing.assert_array_equal(r1, r2)
+
+
 def test_mlp_classifier(session):
     x, y = datagen.classification_data(640, 10, 3, seed=15)
     cfg = nn.NNConfig(layers=(32,), num_classes=3, lr=0.2, batch_size=20,

@@ -41,6 +41,18 @@ def test_pca_matches_numpy_eigh(session, data):
                                atol=1e-3)
 
 
+def test_pca_fit_repeated_matches_fit(session, data):
+    # the bench path: N fits inside one compiled program (lax.scan) must
+    # produce exactly the same result as one host-level fit call
+    model = stats.PCA(session)
+    w1, c1, m1 = model.fit(data)
+    w2, c2, m2 = model.fit_repeated(data, 3)
+    np.testing.assert_allclose(w1, w2, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(m1, m2, rtol=1e-5, atol=1e-5)
+    # eigenvector sign is arbitrary per column; compare up to sign
+    np.testing.assert_allclose(np.abs(c1), np.abs(c2), rtol=1e-3, atol=1e-3)
+
+
 def test_zscore_and_minmax(session, data):
     z = stats.ZScore(session).transform(data)
     np.testing.assert_allclose(z.mean(0), 0.0, atol=1e-4)
