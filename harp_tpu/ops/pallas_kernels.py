@@ -131,6 +131,145 @@ def kmeans_stats_pallas(
     return (sums[:k_orig, :d_orig], counts2d[0, :k_orig], cost1[0, 0])
 
 
+# --------------------------------------------------------------------------- #
+# Dense SGD-MF fused hop (the flagship rotate workload's inner loop)
+# --------------------------------------------------------------------------- #
+#
+# XLA's lowering of the masked stripe-GEMM hop (models/sgd_mf._build_dense)
+# materializes pred and G — two (s_rows, cpb) bf16 intermediates — to HBM and
+# re-reads G for the dW/dH GEMMs: ~5 slab-sized HBM passes per epoch, which IS
+# the measured roofline (~11-13 ms/epoch at 32768², PERF.md r3). This kernel
+# fuses the whole stripe update: pred and G live only in VMEM, so the epoch's
+# HBM traffic collapses to one slab read plus factor-sized I/O. Factors are
+# carried TRANSPOSED — (K, rows) — so every block's lane dimension is a
+# 128-multiple (K rides the sublane dimension, where 8 | K suffices).
+#
+# Grid: (nmb stripes, n_ct column tiles), sequential on TPU with j innermost.
+# Per step: pred = W_sᵀ·H_j (MXU, bf16), G = where(isnan(V), 0, V − pred),
+# dWᵀ += H_j·Gᵀ (accumulated in VMEM scratch across j), dHᵀ = W_sᵀ·G applied
+# to H_j IMMEDIATELY (tile j is touched once per stripe, so in-stripe update
+# order matches the XLA path), W written once at the stripe's last tile.
+# H lives ENTIRELY in VMEM for the whole kernel (full-array out block,
+# initialized from the input at step 0): stripe i+1 reads stripe i's updates
+# with no HBM round trip and no reliance on write-back/prefetch ordering.
+
+
+def _dense_mf_hop_kernel(v_ref, wt_ref, rc_ref, cc_ref, ht_in_ref,
+                         wt_out_ref, ht_ref, sse_ref, dw_ref,
+                         *, lr: float, lam: float, col_tile: int, n_ct: int):
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when((i == 0) & (j == 0))
+    def _init():
+        sse_ref[...] = jnp.zeros_like(sse_ref)
+        ht_ref[...] = ht_in_ref[...]              # H resident in VMEM
+
+    @pl.when(j == 0)
+    def _stripe_start():
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+
+    bf = jnp.bfloat16
+    wt = wt_ref[...]                              # (K, s) f32, pre-update
+    wt_b = wt.astype(bf)
+    cols = pl.ds(j * col_tile, col_tile)
+    ht = ht_ref[:, cols]                          # (K, CT) f32, current
+    ht_b = ht.astype(bf)
+    pred = jax.lax.dot_general(wt_b, ht_b, (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)  # (s, CT)
+    # NaN test in f32: mosaic has no bf16 vector compare (cast is free VPU)
+    vf = v_ref[...].astype(jnp.float32)           # (s, CT); NaN = missing
+    g = jnp.where(jnp.isnan(vf), jnp.zeros_like(pred),
+                  vf - pred).astype(bf)
+    dw_ref[...] += jax.lax.dot_general(
+        ht_b, g, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)       # (K, s)
+    dh = jax.lax.dot_general(
+        wt_b, g, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)       # (K, CT)
+    cc = cc_ref[0:1, :]                           # (1, CT): stripe i's counts
+    ht_ref[:, cols] = ht + lr * (dh - lam * cc * ht)
+    gf = g.astype(jnp.float32)
+    sse_ref[...] += jnp.full((1, 128), jnp.sum(gf * gf) / 128.0, jnp.float32)
+
+    @pl.when(j == n_ct - 1)
+    def _stripe_end():
+        rc = rc_ref[0:1, :]                       # (1, s): stripe i's counts
+        wt_out_ref[...] = wt + lr * (dw_ref[...] - lam * rc * wt)
+
+
+def dense_mf_hop_pallas(vb: jax.Array, w_t: jax.Array, h_t: jax.Array,
+                        rc2: jax.Array, cc2: jax.Array, lr: float, lam: float,
+                        col_tile: int = 256, interpret: bool = False
+                        ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One dense-MF hop. vb (rpw, cpb) bf16 NaN-encoded; w_t (K, rpw) f32;
+    h_t (K, cpb) f32; rc2 (nmb, s_rows) and cc2 (nmb, cpb) regularizer
+    counts. Returns (w_t_new, h_t_new, sse). nmb = rc2.shape[0]."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    nmb, s = rc2.shape
+    k, rpw = w_t.shape
+    cpb = vb.shape[1]
+    if rpw != nmb * s or vb.shape[0] != rpw or h_t.shape[1] != cpb:
+        raise ValueError("dense_mf_hop_pallas: inconsistent shapes")
+    if cpb % col_tile or s % 8 or k % 8 or col_tile % 128:
+        raise ValueError("dense_mf_hop_pallas: tiling constraints violated")
+    n_ct = cpb // col_tile
+    kernel = functools.partial(_dense_mf_hop_kernel, lr=lr, lam=lam,
+                               col_tile=col_tile, n_ct=n_ct)
+    # per-stripe count rows ride in 8-sublane-replicated blocks: mosaic
+    # cannot vector-load a single DYNAMIC sublane row, so give each stripe an
+    # aligned (8, ·) block and read its (static) first row in-kernel
+    rc8 = jnp.broadcast_to(rc2[:, None, :], (nmb, 8, s)).reshape(nmb * 8, s)
+    cc8 = jnp.broadcast_to(cc2[:, None, :],
+                           (nmb, 8, cpb)).reshape(nmb * 8, cpb)
+    # VMEM budget: resident H (in + out copies) + per-step blocks + pred/g,
+    # with 30% headroom for mosaic's own temporaries (measured: the compiler
+    # asks a few MB beyond the naive sum at K=128)
+    vmem_bytes = 1.3 * (2 * k * cpb * 4 + s * col_tile * 2 + 2 * k * s * 4
+                        + k * s * 2 + 4 * s * col_tile
+                        + 2 * k * col_tile * 4) + (8 << 20)
+    w_t_new, h_t_new, sse128 = pl.pallas_call(
+        kernel,
+        grid=(nmb, n_ct),
+        in_specs=[
+            pl.BlockSpec((s, col_tile), lambda i, j: (i, j)),       # vb
+            pl.BlockSpec((k, s), lambda i, j: (0, i)),              # w_t
+            pl.BlockSpec((8, s), lambda i, j: (i, 0)),              # rc8
+            pl.BlockSpec((8, col_tile), lambda i, j: (i, j)),       # cc8
+            pl.BlockSpec((k, cpb), lambda i, j: (0, 0)),            # h_t full
+        ],
+        out_specs=[
+            pl.BlockSpec((k, s), lambda i, j: (0, i)),              # w_t_new
+            pl.BlockSpec((k, cpb), lambda i, j: (0, 0)),            # h_t_new
+            pl.BlockSpec((1, 128), lambda i, j: (0, 0)),            # sse
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((k, rpw), jnp.float32),
+            jax.ShapeDtypeStruct((k, cpb), jnp.float32),
+            jax.ShapeDtypeStruct((1, 128), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((k, s), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=min(int(vmem_bytes), 100 * 1024 * 1024)),
+        interpret=interpret,
+    )(vb, w_t, rc8, cc8, h_t)
+    return w_t_new, h_t_new, jnp.sum(sse128)
+
+
+def use_dense_mf_pallas(cpb: int, s_rows: int, k: int) -> bool:
+    """Dispatch predicate for the fused dense-MF hop: default ON for TPU
+    (measured multi-x win over the XLA lowering — module doc), opt out with
+    HARP_DENSE_PALLAS=0. Shapes must satisfy the kernel's tiling."""
+    import os
+
+    if os.environ.get("HARP_DENSE_PALLAS", "1") == "0" or not _HAVE_PALLAS:
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    return cpb % 128 == 0 and s_rows % 8 == 0 and k % 8 == 0
+
+
 def kmeans_stats(x: jax.Array, c: jax.Array, block_n: int = 256,
                  compute_dtype=None, x_sq_sum=None
                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:

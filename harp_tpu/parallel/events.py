@@ -124,6 +124,14 @@ class EventClient:
         Multi-process: collective like :meth:`send_collective` (all processes
         call, one source, non-dest processes drop the payload). Single-process:
         delivered iff dest is this worker.
+
+        COST: each multi-process send rides ``broadcast_one_to_all``, so a
+        "point-to-point" message costs O(W) bandwidth and synchronizes every
+        process at the call. That is the right trade for a low-rate CONTROL
+        plane (this module's role); if events ever become load-bearing on a
+        large gang (frequent messages, tens of hosts), move the payload to a
+        real P2P transport — device ``send_recv`` (ppermute) for array data,
+        or a host socket channel keyed off the gang env.
         """
         import jax
 

@@ -86,6 +86,9 @@ def run_kmeans(argv) -> int:
     _common_flags(p)
     p.add_argument("--num-points", type=int, default=100_000)
     p.add_argument("--points-file", default="")
+    p.add_argument("--save-every", type=int, default=0,
+                   help="checkpoint centroids every N iterations into "
+                        "work-dir (resumes automatically)")
     _add_config_flags(p, KMeansConfig)
     args = p.parse_args(argv)
     sess = _session(args)
@@ -104,17 +107,37 @@ def run_kmeans(argv) -> int:
     cen0 = datagen.initial_centroids(pts, cfg.num_centroids, seed=args.seed + 1)
     model = km.KMeans(sess, cfg)
     pts_dev, cen_dev = model.prepare(pts, cen0)
-    model.fit_prepared(pts_dev, cen_dev)          # compile + warmup
-    t0 = time.perf_counter()
-    cen, costs = model.fit_prepared(pts_dev, cen_dev)
-    costs = np.asarray(costs)
-    dt = time.perf_counter() - t0
-    print(f"kmeans[{cfg.comm}] workers={sess.num_workers} n={len(pts)} "
-          f"k={cfg.num_centroids} d={cfg.dim}: {cfg.iterations / dt:.2f} "
-          f"iters/s, cost {costs[0]:.1f} -> {costs[-1]:.1f}")
+    if args.save_every and not args.work_dir:
+        p.error("--save-every requires --work-dir (nowhere to checkpoint)")
+    if args.save_every:
+        from harp_tpu.utils.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(os.path.join(args.work_dir, "ckpt"))
+        t0 = time.perf_counter()
+        cen, costs, start = model.fit_checkpointed(
+            pts_dev, cen_dev, ckpt, save_every=args.save_every)
+        ran = cfg.iterations - start
+        dt = time.perf_counter() - t0
+        timing = " (incl compile)"
+    else:
+        model.fit_prepared(pts_dev, cen_dev)      # compile + warmup
+        t0 = time.perf_counter()
+        cen, costs = model.fit_prepared(pts_dev, cen_dev)
+        ran = cfg.iterations
+        dt = time.perf_counter() - t0
+        timing = ""
+    if ran > 0:
+        costs = np.asarray(costs)
+        print(f"kmeans[{cfg.comm}] workers={sess.num_workers} n={len(pts)} "
+              f"k={cfg.num_centroids} d={cfg.dim}: {ran / dt:.2f} "
+              f"iters/s{timing}, cost {costs[0]:.1f} -> {costs[-1]:.1f}")
+    else:
+        print(f"kmeans[{cfg.comm}] workers={sess.num_workers}: fully "
+              f"resumed from checkpoint, nothing left to run")
     if args.work_dir:
         os.makedirs(args.work_dir, exist_ok=True)
-        # reference: KMUtil.storeCentroids writes the final model
+        # reference: KMUtil.storeCentroids writes the final model (also on a
+        # fully-resumed run — the restored centroids ARE the model)
         np.savetxt(os.path.join(args.work_dir, "centroids.csv"),
                    np.asarray(cen), delimiter=",")
     return 0
@@ -196,6 +219,10 @@ def run_lda(argv) -> int:
     _common_flags(p)
     p.add_argument("--num-docs", type=int, default=1024)
     p.add_argument("--doc-len", type=int, default=64)
+    p.add_argument("--save-every", type=int, default=0,
+                   help="checkpoint the chain (z + word-topic model) every "
+                        "N epochs into work-dir (printModel parity; resumes "
+                        "automatically)")
     _add_config_flags(p, LDAConfig)
     args = p.parse_args(argv)
     sess = _session(args)
@@ -211,14 +238,33 @@ def run_lda(argv) -> int:
                               seed=args.seed)
     model = lda.LDA(sess, cfg)
     state = model.prepare(docs, seed=args.seed)   # host layout + H2D once
-    model.fit_prepared(state)                     # compile + warmup
-    t0 = time.perf_counter()
-    _, _, ll = model.fit_prepared(state)
-    dt = time.perf_counter() - t0
-    toks = docs.size * cfg.epochs
+    if args.save_every and not args.work_dir:
+        p.error("--save-every requires --work-dir (nowhere to checkpoint)")
+    if args.save_every:
+        from harp_tpu.utils.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(os.path.join(args.work_dir, "ckpt"))
+        t0 = time.perf_counter()
+        _, _, ll, start = model.fit_checkpointed(
+            state, ckpt, save_every=args.save_every)
+        ran = cfg.epochs - start
+        dt = time.perf_counter() - t0
+        timing = " (incl compile)"
+        if ran <= 0:
+            print(f"lda[cgs] workers={sess.num_workers}: fully resumed "
+                  f"from checkpoint, nothing left to run")
+            return 0
+    else:
+        model.fit_prepared(state)                 # compile + warmup
+        t0 = time.perf_counter()
+        _, _, ll = model.fit_prepared(state)
+        ran = cfg.epochs
+        dt = time.perf_counter() - t0
+        timing = ""
+    toks = docs.size * ran
     print(f"lda[cgs] workers={sess.num_workers} docs={num_docs} "
           f"vocab={cfg.vocab} K={cfg.num_topics}: {toks / dt / 1e6:.2f} "
-          f"M tokens/s, ll {ll[0]:.4e} -> {ll[-1]:.4e}")
+          f"M tokens/s{timing}, ll {ll[0]:.4e} -> {ll[-1]:.4e}")
     return 0
 
 

@@ -1,0 +1,96 @@
+"""Reference-format dataset ingestion, end to end (VERDICT r2 #10).
+
+The reference shipped per-algorithm datasets its launchers consumed
+(/root/reference/datasets/): MovieLens-format COO ratings for daal_als/sgd
+(``user item rating`` lines, one file per split — movielens-train/x*),
+dense CSV row blocks for daal_kmeans (densedistri/kmeans_dense_*.csv,
+HarpDAALDataSource.loadDenseCSV). These tests write synthetic fixtures in
+those EXACT on-disk formats, then drive the full pipeline a reference user
+would: split files → loaders (native mmap parser when built, numpy
+fallback) → regroup → prepare → fit, asserting convergence.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from harp_tpu.io import datagen, loaders
+from harp_tpu.models import kmeans as km
+from harp_tpu.models import sgd_mf
+
+W = 8
+
+
+@pytest.fixture(scope="module")
+def movielens_dir(tmp_path_factory):
+    """A MovieLens-format ratings directory: 4 split files of
+    ``user item rating`` lines (the reference's movielens-train/x00* shape),
+    generated from a rank-4 ground-truth model so training can provably fit
+    it."""
+    root = tmp_path_factory.mktemp("movielens")
+    rows, cols, vals = datagen.sparse_ratings(256, 192, rank=4,
+                                              density=0.08, seed=11)
+    order = np.random.default_rng(0).permutation(len(rows))
+    rows, cols, vals = rows[order], cols[order], vals[order]
+    splits = np.array_split(np.arange(len(rows)), 4)
+    for i, idx in enumerate(splits):
+        with open(os.path.join(root, f"x{i:05d}"), "w") as f:
+            for r, c, v in zip(rows[idx], cols[idx], vals[idx]):
+                f.write(f"{r} {c} {v:.6f}\n")
+    return str(root), (rows, cols, vals)
+
+
+def test_movielens_files_to_sgd_mf_convergence(session, movielens_dir):
+    root, (rows0, cols0, vals0) = movielens_dir
+    paths = sorted(os.path.join(root, p) for p in os.listdir(root))
+    assert len(paths) == 4
+    # reference flow: split files across workers, load each split, regroup
+    per_worker = loaders.split_files(paths, 4)
+    assert all(chunk for chunk in per_worker)
+    rows, cols, vals = loaders.load_coo(paths)
+    assert len(rows) == len(rows0)
+    # loaded triples match what was written (order-insensitive)
+    key = lambda r, c: np.asarray(r) * 192 + np.asarray(c)
+    np.testing.assert_array_equal(np.sort(key(rows, cols)),
+                                  np.sort(key(rows0, cols0)))
+    groups = loaders.regroup_coo_by_row(rows, cols, vals, W)
+    assert sum(len(g[0]) for g in groups) == len(rows)
+
+    cfg = sgd_mf.SGDMFConfig(rank=8, lam=0.01, lr=0.1, epochs=30,
+                             minibatches_per_hop=4)
+    model = sgd_mf.SGDMF(session, cfg)
+    _, _, rmse = model.fit(rows.astype(np.int64), cols.astype(np.int64),
+                           vals.astype(np.float32), 256, 192, seed=0)
+    assert rmse[-1] < 0.5 * rmse[0], rmse
+
+
+def test_movielens_files_to_coo_csr(movielens_dir):
+    root, _ = movielens_dir
+    paths = sorted(os.path.join(root, p) for p in os.listdir(root))
+    rows, cols, vals = loaders.load_coo(paths)
+    indptr, indices, values = loaders.coo_to_csr(rows, cols, vals,
+                                                 num_rows=256)
+    assert indptr[-1] == len(rows)
+    # CSR row slices hold exactly that row's entries
+    r = int(rows[0])
+    sl = slice(indptr[r], indptr[r + 1])
+    assert (np.sort(indices[sl])
+            == np.sort(cols[rows == r])).all()
+
+
+def test_kmeans_dense_csv_blocks_to_fit(session, tmp_path):
+    # densedistri format: one dense CSV per mapper (kmeans_dense_<i>.csv)
+    pts = datagen.dense_points(512, 12, seed=4, num_clusters=5)
+    paths = []
+    for i, block in enumerate(np.array_split(pts, 4)):
+        p = str(tmp_path / f"kmeans_dense_{i + 1}.csv")
+        np.savetxt(p, block, delimiter=",", fmt="%.6f")
+        paths.append(p)
+    loaded = loaders.load_dense_csv(paths)
+    np.testing.assert_allclose(loaded, pts, rtol=1e-5, atol=1e-5)
+    cen0 = datagen.initial_centroids(loaded, 5, seed=1)
+    model = km.KMeans(session, km.KMeansConfig(5, 12, iterations=10))
+    _, costs = model.fit(loaded, cen0)
+    costs = np.asarray(costs)
+    assert costs[-1] < costs[0]

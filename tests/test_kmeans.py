@@ -76,3 +76,30 @@ def test_bad_point_count_raises(session, data):
 def test_bad_comm_variant(session):
     with pytest.raises(ValueError, match="comm must be"):
         km.KMeans(session, km.KMeansConfig(comm="telepathy"))
+
+
+def test_kmeans_fit_checkpointed_resume_equivalence(session, tmp_path):
+    from harp_tpu.utils.checkpoint import Checkpointer
+
+    pts = datagen.dense_points(160, 8, seed=0, num_clusters=4)
+    cen0 = datagen.initial_centroids(pts, 4, seed=1)
+    model = km.KMeans(session, km.KMeansConfig(4, 8, iterations=6))
+    pts_dev, cen_dev = model.prepare(pts, cen0)
+    cen_full, costs_full = model.fit_prepared(pts_dev, cen_dev)
+
+    # uninterrupted checkpointed run is bitwise the full-scan trajectory
+    ck1 = Checkpointer(str(tmp_path / "a"), use_orbax=False)
+    cen_c, costs_c, start = model.fit_checkpointed(pts_dev, cen_dev, ck1,
+                                                   save_every=2)
+    assert start == 0
+    np.testing.assert_array_equal(np.asarray(cen_full), np.asarray(cen_c))
+    np.testing.assert_array_equal(np.asarray(costs_full), costs_c)
+
+    # interrupt after 4 of 6 iterations; the resumed run completes bitwise
+    ck2 = Checkpointer(str(tmp_path / "b"), use_orbax=False)
+    model.fit_checkpointed(pts_dev, cen_dev, ck2, save_every=2, iterations=4)
+    cen_r, costs_r, start_r = model.fit_checkpointed(pts_dev, cen_dev, ck2,
+                                                     save_every=2)
+    assert start_r == 4 and len(costs_r) == 2
+    np.testing.assert_array_equal(np.asarray(cen_full), np.asarray(cen_r))
+    np.testing.assert_array_equal(np.asarray(costs_full)[4:], costs_r)

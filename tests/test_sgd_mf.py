@@ -238,3 +238,56 @@ def test_nan_ratings_rejected_and_auto_dense_respects_int32_guard(session):
         rank=4, epochs=1, dense_max_bytes=1 << 62))
     assert big._choose_layout(200_000, 200_000) == "sparse"
     assert big._choose_layout(512, 512) == "dense"
+
+
+def test_dense_mf_hop_pallas_matches_xla_stripes():
+    """The fused pallas hop (interpret mode on CPU) is bit-comparable to the
+    XLA stripe loop in models/sgd_mf._build_dense."""
+    import jax
+    import jax.numpy as jnp
+
+    from harp_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.default_rng(0)
+    NMB, S, CPB, K = 2, 16, 256, 8
+    RPW = NMB * S
+    LR, LAM = 0.05, 0.01
+    v = rng.random((RPW, CPB)).astype(np.float32)
+    v[rng.random((RPW, CPB)) < 0.9] = np.nan
+    vb = jnp.asarray(v, jnp.bfloat16)
+    w0 = jnp.asarray(rng.random((RPW, K)), jnp.float32)
+    h0 = jnp.asarray(rng.random((CPB, K)), jnp.float32)
+    rc = jnp.asarray(rng.integers(1, 5, RPW), jnp.float32)
+    cc = jnp.asarray(rng.integers(1, 5, (NMB, CPB)), jnp.float32)
+    bf = jnp.bfloat16
+
+    def stripe(state, xs):
+        hb, sse = state
+        w_s, v_s, rc_s, cc_s = xs
+        hb_b = hb.astype(bf)
+        pred = jax.lax.dot_general(w_s.astype(bf), hb_b,
+                                   (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+        g = jnp.where(jnp.isnan(v_s), jnp.asarray(0.0),
+                      v_s.astype(jnp.float32) - pred).astype(bf)
+        dw = jax.lax.dot_general(g, hb_b, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        dh = jax.lax.dot_general(g, w_s.astype(bf), (((0,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        w_s = w_s + LR * (dw - LAM * rc_s[:, None] * w_s)
+        hb = hb + LR * (dh - LAM * cc_s[:, None] * hb)
+        sse = sse + jnp.sum(g.astype(jnp.float32) ** 2)
+        return (hb, sse), w_s
+
+    (h_ref, sse_ref), w_ref = jax.lax.scan(
+        stripe, (h0, jnp.zeros(())),
+        (w0.reshape(NMB, S, K), vb.reshape(NMB, S, CPB),
+         rc.reshape(NMB, S), cc))
+    w_t, h_t, sse_pl = pk.dense_mf_hop_pallas(
+        vb, w0.T, h0.T, rc.reshape(NMB, S), cc, LR, LAM, col_tile=128,
+        interpret=True)
+    np.testing.assert_allclose(np.asarray(w_ref.reshape(RPW, K)),
+                               np.asarray(w_t.T), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h_ref), np.asarray(h_t.T),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(sse_ref), float(sse_pl), rtol=1e-4)
