@@ -41,6 +41,7 @@ def rotate_scan(
     model_block: Slice,
     num_steps: int,
     axis_name: str = WORKERS,
+    shift: int = 1,
 ) -> Tuple[Carry, Slice]:
     """Unpipelined rotation loop: compute on the block, then shift it.
 
@@ -48,12 +49,18 @@ def rotate_scan(
     num_workers, every worker has seen (and updated) every model block once and each
     block is home again. This is Harp's plain ``rotate()`` loop
     (LocalGlobalSyncCollective.rotate:710 called per iteration).
+
+    ``shift=0`` skips the permute entirely — a timing ablation that keeps the
+    compute schedule but removes the collective (the block never moves, so the
+    RESULT is wrong); used only to measure the rotation's share of hop time.
     """
 
     def step(state, t):
         c, blk = state
         c, blk = body(c, blk, t)
-        blk = jax.tree.map(lambda x: lax_ops.rotate(x, 1, axis_name), blk)
+        if shift:
+            blk = jax.tree.map(lambda x: lax_ops.rotate(x, shift, axis_name),
+                               blk)
         return (c, blk), None
 
     (carry, model_block), _ = jax.lax.scan(step, (carry, model_block),
@@ -68,6 +75,7 @@ def pipelined_rotation(
     slice_b: Slice,
     num_micro_steps: int,
     axis_name: str = WORKERS,
+    shift: int = 1,
 ) -> Tuple[Carry, Slice, Slice]:
     """Double-buffered rotation: compute on one slice while the other is in flight.
 
@@ -82,12 +90,18 @@ def pipelined_rotation(
 
     Returns (carry, slice_a', slice_b') with both slices at their original
     positions when num_micro_steps is a multiple of 2*num_workers.
+
+    ``shift=0``: timing ablation, see :func:`rotate_scan` (slices still swap
+    resident/inflight roles but never cross workers).
     """
 
     def step(state, t):
         c, resident, inflight = state
         c, updated = body(c, resident, t)
-        outgoing = jax.tree.map(lambda x: lax_ops.rotate(x, 1, axis_name), updated)
+        outgoing = updated
+        if shift:
+            outgoing = jax.tree.map(
+                lambda x: lax_ops.rotate(x, shift, axis_name), updated)
         # inflight was issued last step; it is resident for the next step. XLA sees
         # `outgoing` unused until step t+1 → overlaps the permute with t+1's compute.
         return (c, inflight, outgoing), None

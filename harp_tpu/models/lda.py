@@ -22,13 +22,16 @@ TPU-native reformulation (SURVEY §7 "hard parts" — async semantics under SPMD
   between updates); convergence is statistical, not token-sequential.
 * Topic totals n_k are refreshed by psum once per hop — bounded staleness,
   replacing Harp's asynchronously drifting totals.
-* Deviation: the reference splits the word-topic table into numModelSlices=2
-  pipelined slices (LDAMPCollectiveMapper wTableMap[k]); here the rotation is
-  single-slice — the sampler's sequential doc-group sub-steps already fill
-  the hop, and XLA's async collective scheduling overlaps the block ppermute
-  with the next hop's leading compute, which is what the second slice bought
-  the reference (the double-buffered substrate exists in
-  collectives.rotation.pipelined_rotation and is exercised by SGD-MF).
+* The reference splits the word-topic table into numModelSlices=2 pipelined
+  slices (LDAMPCollectiveMapper wTableMap[k]) so rotation overlaps sampling.
+  Both schedules exist here: ``num_model_slices=1`` (single-slice
+  rotate_scan; XLA's async collective scheduler overlaps the block ppermute
+  with the next hop's leading compute) and ``num_model_slices=2``
+  (half-width blocks on collectives.rotation.pipelined_rotation — while one
+  half-slice is being sampled the other is in flight, the reference's exact
+  schedule). ``ablate_rotation=True`` keeps the compute schedule but drops
+  the ppermute — a timing-only ablation benchmark/lda_overlap.py uses to
+  measure the rotation's share of hop time (results in PERF.md).
 
 Likelihood monitor: the REFERENCE formula, exactly (CalcLikelihoodTask.run:56 +
 the topic-sum completion in printLikelihood, LDAMPCollectiveMapper.java:731-748
@@ -75,6 +78,12 @@ class LDAConfig:
     #   one-hot matmuls on the MXU (f32 one-hot: counts are integers beyond
     #   bf16's 8-bit mantissa); costs FLOPs ∝ vocab-block width, so "auto"
     #   picks gemm only for blocks <= 8192 wide
+    num_model_slices: int = 1   # 1 = plain rotate_scan; 2 = the reference's
+    #   numModelSlices=2 double-buffered schedule (half-width vocab blocks on
+    #   pipelined_rotation: sample one half-slice while the other rotates)
+    ablate_rotation: bool = False  # timing ablation ONLY: keep the exact
+    #   compute schedule but skip the ppermute (results are wrong — blocks
+    #   never move); lets benchmark/lda_overlap.py price the rotation
     minibatches_per_hop: int = 4  # sequential doc-group sub-steps per hop:
     #   fully-parallel draws let every token of a word resample against the
     #   SAME stale word-topic row each round (a word's tokens can never
@@ -127,6 +136,9 @@ class LDA:
         if config.method not in ("cgs", "cvb0"):
             raise ValueError(f"method must be 'cgs' or 'cvb0', got "
                              f"{config.method!r}")
+        if config.num_model_slices not in (1, 2):
+            raise ValueError(f"num_model_slices must be 1 or 2, got "
+                             f"{config.num_model_slices}")
         self.session = session
         self.config = config
         self._fns = {}
@@ -141,7 +153,10 @@ class LDA:
     def _build(self, w: int, v_pad: int, lb: int, d_local: int):
         cfg = self.config
         k = cfg.num_topics
-        vpb = v_pad // w                      # vocab per block
+        ns = cfg.num_model_slices
+        nb = w * ns                           # rotating vocab blocks in total
+        vpb = v_pad // nb                     # vocab per block
+        shift = 0 if cfg.ablate_rotation else 1
         nmb = self._effective_minibatches(d_local)
         dg = d_local // nmb
         if cfg.wt_access not in ("auto", "gemm", "gather"):
@@ -156,7 +171,7 @@ class LDA:
                         and onehot_bytes <= 256 * 1024 * 1024))
 
         def fit_fn(docs_b, mask_b, z0, wt_block0, seed):
-            # docs_b/mask_b/z0: (D_local, W, Lb) — tokens pre-bucketed by home
+            # docs_b/mask_b/z0: (D_local, NB, Lb) — tokens pre-bucketed by home
             # vocab block (host-side, bucketize_tokens; ids are block-local
             # slots), so each hop touches only the resident block's tokens
             # instead of sampling all tokens and discarding (w-1)/w of draws.
@@ -206,10 +221,9 @@ class LDA:
                 return (wt_block, tt_local + d_k, d_k, key,
                         zs_new, dt_g + delta.sum(axis=1))
 
-            def hop_body(carry, wt_block, t):
+            def sample_resident(carry, wt_block, src):
+                """Sample every token whose home block ``src`` is resident."""
                 doc_topic, z, topic_tot, key = carry
-                wid = lax_ops.worker_id()
-                src = (wid - t) % w           # home block of resident slice
                 w_local = jnp.take(docs_b, src, axis=1)       # (D, Lb) slots
                 mask_s = jnp.take(mask_b, src, axis=1)
                 z_s = jnp.take(z, src, axis=1)
@@ -232,15 +246,30 @@ class LDA:
                 doc_topic = dt_new.reshape(d_local, k)
                 zs_new = zs_new.reshape(z_s.shape)
                 if soft:
-                    z = jnp.where((jnp.arange(w) == src)[None, :, None, None],
+                    z = jnp.where((jnp.arange(nb) == src)[None, :, None, None],
                                   zs_new[:, None, :, :], z)
                 else:
-                    z = jnp.where((jnp.arange(w) == src)[None, :, None],
+                    z = jnp.where((jnp.arange(nb) == src)[None, :, None],
                                   zs_new[:, None, :], z)
                 # bounded-staleness topic totals: refresh by psum once per hop
                 topic_tot = topic_tot + jax.lax.psum(hop_delta,
                                                      lax_ops.WORKERS)
                 return (doc_topic, z, topic_tot, key), wt_block
+
+            def hop_body(carry, wt_block, t):
+                # single-slice schedule: at hop t the resident block's home
+                # worker is (wid - t) — Harp's plain Rotator ring
+                src = (lax_ops.worker_id() - t) % w
+                return sample_resident(carry, wt_block, src)
+
+            def micro_body(carry, wt_half, t):
+                # numModelSlices=2 schedule (LDAMPCollectiveMapper wTableMap):
+                # even micro-steps sample an a-half-block (ids [0, w)), odd
+                # ones a b-half-block (ids [w, 2w)); each advances around the
+                # ring every SECOND micro-step, so while this half is being
+                # sampled the other is in flight (pipelined_rotation)
+                src = (t % 2) * w + (lax_ops.worker_id() - t // 2) % w
+                return sample_resident(carry, wt_half, src)
 
             key = jax.random.fold_in(jax.random.PRNGKey(0),
                                      seed + lax_ops.worker_id())
@@ -254,10 +283,7 @@ class LDA:
             lgamma = jax.scipy.special.gammaln
             v_beta = cfg.vocab * cfg.beta
 
-            def epoch(state, _):
-                doc_topic, z, topic_tot, wt, key = state
-                (doc_topic, z, topic_tot, key), wt = rotation.rotate_scan(
-                    hop_body, (doc_topic, z, topic_tot, key), wt, w)
+            def ref_ll(wt, topic_tot):
                 # REFERENCE log-likelihood (CalcLikelihoodTask.run:56 +
                 # printLikelihood:731-748): nonzero word-topic cells only,
                 # then the topic-sum completion terms. Exact for CGS (integer
@@ -268,8 +294,24 @@ class LDA:
                     jnp.sum(jnp.where(nz, lgamma(wt + cfg.beta)
                                       - lgamma(cfg.beta), 0.0)),
                     lax_ops.WORKERS)
-                ll = (ll_w - jnp.sum(lgamma(topic_tot + v_beta))
-                      + k * lgamma(v_beta))
+                return (ll_w - jnp.sum(lgamma(topic_tot + v_beta))
+                        + k * lgamma(v_beta))
+
+            def epoch(state, _):
+                doc_topic, z, topic_tot, wt, key = state
+                if ns == 1:
+                    (doc_topic, z, topic_tot, key), wt = rotation.rotate_scan(
+                        hop_body, (doc_topic, z, topic_tot, key), wt, w,
+                        shift=shift)
+                else:
+                    # local (2*vpb, K) block = [a-half; b-half]; 2w micro-steps
+                    # bring both halves home again
+                    (doc_topic, z, topic_tot, key), sa, sb = (
+                        rotation.pipelined_rotation(
+                            micro_body, (doc_topic, z, topic_tot, key),
+                            wt[:vpb], wt[vpb:], 2 * w, shift=shift))
+                    wt = jnp.concatenate([sa, sb], axis=0)
+                ll = ref_ll(wt, topic_tot)
                 return (doc_topic, z, topic_tot, wt, key), ll
 
             (doc_topic, z, topic_tot, wt, key), ll = jax.lax.scan(
@@ -293,8 +335,9 @@ class LDA:
         and H2D transfer out of timed regions (KMeans.prepare idiom)."""
         sess, cfg = self.session, self.config
         w = sess.num_workers
-        vpb = -(-cfg.vocab // w)
-        v_pad = vpb * w
+        nb = w * cfg.num_model_slices
+        vpb = -(-cfg.vocab // nb)
+        v_pad = vpb * nb
         num_docs = docs.shape[0]
         if num_docs % w:
             raise ValueError(f"num_docs {num_docs} must divide over {w} workers")
@@ -307,11 +350,11 @@ class LDA:
 
         if cfg.balance:
             word_block, word_slot = serpentine_assign(
-                np.bincount(docs.reshape(-1), minlength=cfg.vocab), w)
+                np.bincount(docs.reshape(-1), minlength=cfg.vocab), nb)
         else:
-            word_block, word_slot = identity_assign(cfg.vocab, w)
+            word_block, word_slot = identity_assign(cfg.vocab, nb)
 
-        docs_b, mask_b, lb = bucketize_tokens(docs, w, vpb, word_block,
+        docs_b, mask_b, lb = bucketize_tokens(docs, nb, vpb, word_block,
                                               word_slot)
         d_local = num_docs // w
         nmb_eff = self._effective_minibatches(d_local)
@@ -340,21 +383,25 @@ class LDA:
         }
         rng = np.random.default_rng(seed)
         z0 = rng.integers(0, cfg.num_topics, docs_b.shape).astype(np.int32)
-        # initial word-topic counts, laid out as W stacked vocab blocks of
+        # initial word-topic counts, laid out as NB stacked vocab blocks of
         # block-local slots
-        wt = np.zeros((w, vpb, cfg.num_topics), np.float32)
-        blk = np.broadcast_to(np.arange(w)[None, :, None],
+        wt = np.zeros((nb, vpb, cfg.num_topics), np.float32)
+        blk = np.broadcast_to(np.arange(nb)[None, :, None],
                               docs_b.shape).reshape(-1)
         np.add.at(wt, (blk, docs_b.reshape(-1)),
                   np.eye(cfg.num_topics, dtype=np.float32)[z0.reshape(-1)]
                   * mask_b.reshape(-1, 1))
+        if cfg.num_model_slices == 2:
+            # worker i's shard = [a-block i; b-block w+i] stacked — the two
+            # half-slices pipelined_rotation double-buffers
+            wt = wt.reshape(2, w, vpb, cfg.num_topics).transpose(1, 0, 2, 3)
         wt = wt.reshape(v_pad, cfg.num_topics)
         if cfg.method == "cvb0":
             # soft assignments: one-hot init (same counts as the CGS init)
             z0 = (np.eye(cfg.num_topics, dtype=np.float32)[z0]
                   * mask_b[..., None])
 
-        key = (w, v_pad, lb, num_docs, cfg.method)
+        key = (w, v_pad, lb, num_docs, cfg.method, cfg.num_model_slices)
         if key not in self._fns:
             self._fns[key] = self._build(w, v_pad, lb, num_docs // w)
         return (key,
@@ -365,6 +412,15 @@ class LDA:
                 jnp.asarray(seed, jnp.int32),
                 (word_block, word_slot, vpb))
 
+    def _out_rows(self, w: int, word_block: np.ndarray,
+                  word_slot: np.ndarray, vpb: int) -> np.ndarray:
+        """Row of each original vocab id in the scattered wt output: block
+        b lives on worker b % w; with 2 slices the shard stacks [a; b]."""
+        ns = self.config.num_model_slices
+        owner = (word_block % w).astype(np.int64)
+        sl = word_block // w
+        return (owner * ns + sl) * vpb + word_slot
+
     def fit_prepared(self, state
                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Run training on already-placed device data (no host prep)."""
@@ -372,7 +428,7 @@ class LDA:
         doc_topic, wt_out, z, ll = self._fns[key](*data, seed)
         # un-permute word rows back to original vocab ids
         wt_out = np.asarray(wt_out)
-        wt_final = wt_out[word_block.astype(np.int64) * vpb + word_slot]
+        wt_final = wt_out[self._out_rows(key[0], word_block, word_slot, vpb)]
         return np.asarray(doc_topic), wt_final, np.asarray(ll)
 
     def fit(self, docs: np.ndarray, seed: int = 0
@@ -420,7 +476,7 @@ class LDA:
                       "wt": np.zeros(wt_cur.shape, wt_cur.dtype)})
             z_cur = sess.scatter(jnp.asarray(saved["z"]))
             wt_cur = sess.scatter(jnp.asarray(saved["wt"]))
-        w, v_pad, lb, num_docs, _ = key
+        w, v_pad, lb, num_docs = key[:4]
         chunk_fns = {}
         lls = []
         doc_topic = None
@@ -442,9 +498,21 @@ class LDA:
         if hasattr(checkpointer, "wait"):
             checkpointer.wait()       # surface a failed async final write
         wt_out = np.asarray(wt_cur)
-        wt_final = wt_out[word_block.astype(np.int64) * vpb + word_slot]
-        dt = (np.asarray(doc_topic) if doc_topic is not None
-              else np.zeros((num_docs, cfg.num_topics), np.float32))
+        wt_final = wt_out[self._out_rows(w, word_block, word_slot, vpb)]
+        if doc_topic is not None:
+            dt = np.asarray(doc_topic)
+        else:
+            # checkpoint already covered every requested epoch: no chunk ran,
+            # so rebuild doc_topic from the restored assignments z (counts of
+            # each doc's unmasked tokens per topic — same formula as the
+            # in-program init) instead of fabricating zeros
+            z_h = np.asarray(z_cur)
+            m_h = np.asarray(mask_b)
+            if cfg.method == "cvb0":
+                dt = (z_h * m_h[..., None]).sum(axis=(1, 2))
+            else:
+                dt = (np.eye(cfg.num_topics, dtype=np.float32)[z_h]
+                      * m_h[..., None]).sum(axis=(1, 2))
         return dt, wt_final, np.asarray(lls, np.float32), start
 
 

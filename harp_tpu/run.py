@@ -91,6 +91,9 @@ def run_kmeans(argv) -> int:
                         "work-dir (resumes automatically)")
     _add_config_flags(p, KMeansConfig)
     args = p.parse_args(argv)
+    if args.save_every and not args.work_dir:
+        # argparse usage error — fail before data gen / session / prepare
+        p.error("--save-every requires --work-dir (nowhere to checkpoint)")
     sess = _session(args)
     import numpy as np
 
@@ -107,8 +110,6 @@ def run_kmeans(argv) -> int:
     cen0 = datagen.initial_centroids(pts, cfg.num_centroids, seed=args.seed + 1)
     model = km.KMeans(sess, cfg)
     pts_dev, cen_dev = model.prepare(pts, cen0)
-    if args.save_every and not args.work_dir:
-        p.error("--save-every requires --work-dir (nowhere to checkpoint)")
     if args.save_every:
         from harp_tpu.utils.checkpoint import Checkpointer
 
@@ -158,6 +159,10 @@ def run_sgd_mf(argv) -> int:
                         "automatically if checkpoints exist)")
     _add_config_flags(p, SGDMFConfig)
     args = p.parse_args(argv)
+    if args.save_every and not args.work_dir:
+        # argparse usage error — fail before data gen / session / prepare
+        # (was silently ignored here while kmeans/lda errored)
+        p.error("--save-every requires --work-dir (nowhere to checkpoint)")
     sess = _session(args)
     import numpy as np
 
@@ -172,7 +177,7 @@ def run_sgd_mf(argv) -> int:
     state = model.prepare(rows, cols, vals, args.num_users, args.num_items,
                           seed=args.seed)
     t0 = time.perf_counter()
-    if args.save_every and args.work_dir:
+    if args.save_every:
         from harp_tpu.utils.checkpoint import Checkpointer
 
         ckpt = Checkpointer(os.path.join(args.work_dir, "ckpt"))
@@ -225,6 +230,9 @@ def run_lda(argv) -> int:
                         "automatically)")
     _add_config_flags(p, LDAConfig)
     args = p.parse_args(argv)
+    if args.save_every and not args.work_dir:
+        # argparse usage error — fail before data gen / session / prepare
+        p.error("--save-every requires --work-dir (nowhere to checkpoint)")
     sess = _session(args)
     import numpy as np
 
@@ -238,8 +246,6 @@ def run_lda(argv) -> int:
                               seed=args.seed)
     model = lda.LDA(sess, cfg)
     state = model.prepare(docs, seed=args.seed)   # host layout + H2D once
-    if args.save_every and not args.work_dir:
-        p.error("--save-every requires --work-dir (nowhere to checkpoint)")
     if args.save_every:
         from harp_tpu.utils.checkpoint import Checkpointer
 
