@@ -19,7 +19,9 @@ builds a HarpSession over the GLOBAL mesh, and exercises:
   across processes,
 * the host event control plane's multi-process branches
   (``EventClient.send_collective`` / ``send_message`` over
-  ``multihost_utils.broadcast_one_to_all``),
+  ``multihost_utils.broadcast_one_to_all``) AND the true P2P transport
+  (``parallel.p2p.P2PTransport``: KV-store rendezvous, async TCP delivery,
+  ring-neighbor messaging with no gang-wide call),
 * ``HarpSession.barrier()``'s multihost branch and a clean
   ``distributed.shutdown`` (CollectiveMapper teardown :783-788).
 
@@ -125,6 +127,26 @@ def run(process_id: int, num_processes: int, port: int,
         assert ev.payload == "direct"
     else:
         assert ev is None
+
+    # --- true P2P transport (SyncClient/Server residual): rendezvous through
+    # the gang coordinator's KV store, async delivery, only 2 processes touch
+    # each message -------------------------------------------------------- #
+    from harp_tpu.parallel.p2p import P2PTransport
+
+    p2p_q = EventQueue()
+    with P2PTransport(p2p_q, rank=process_id) as transport:
+        p2p_client = EventClient(p2p_q, worker_id=process_id,
+                                 transport=transport)
+        # ring: each process messages ONLY its successor (no gang-wide call)
+        nxt = (process_id + 1) % num_processes
+        p2p_client.send_message(nxt, {"hop": process_id, "blob": b"x" * 4096})
+        ev = p2p_q.wait(timeout=60.0)
+        assert ev is not None and ev.type is EventType.MESSAGE, ev
+        assert ev.source == (process_id - 1) % num_processes
+        assert ev.payload["hop"] == ev.source
+        assert len(ev.payload["blob"]) == 4096
+        # barrier before close so no send races a closed server
+        multihost_utils.sync_global_devices("p2p-smoke-done")
 
     # --- barrier + teardown --------------------------------------------------- #
     sess.barrier()          # multihost branch: sync_global_devices

@@ -1,0 +1,274 @@
+"""True point-to-point host event transport — the residual TCP substrate.
+
+Reference parity: the event side of Harp's L1 comm layer — a per-worker
+``Server`` accepting connections (server/Server.java:40, accept loop :184) with
+a reader per connection (server/Acceptor.java:33), ``SyncClient``'s outbound
+sends (client/SyncClient.java:33), pooled outbound connections
+(io/ConnPool.java:30), send retries (io/Constant.java:50-53), and ``Data``'s
+length-prefixed framing (io/Data.java:31). SURVEY §1 L1: under XLA the bulk
+data plane disappears and "only a small host-side control-plane remains" —
+this module is that residual.
+
+It closes VERDICT r2 weak #5: ``EventClient.send_message`` rode
+``broadcast_one_to_all``, so every "point-to-point" message cost O(W)
+bandwidth and synchronized the whole gang. A :class:`P2PTransport` send
+touches exactly two processes, delivers asynchronously into the receiver's
+:class:`~harp_tpu.parallel.events.EventQueue` (no collective call pattern),
+and scales to frequent events on large gangs.
+
+Addressing: pass an explicit ``{rank: (host, port)}`` map, or let members
+rendezvous through the jax.distributed coordinator's key-value store (the
+same service that replaced Harp's HDFS ``<jobID>/nodes`` files): each member
+publishes ``harp/p2p/<rank> = host:port`` and peers resolve lazily on first
+send.
+
+Wire format: 8-byte big-endian length + pickle of ``(source, payload)``.
+Pickle over gang sockets matches the reference's trust model (it moved
+Java-serialized objects over its TCP links, HarpDAALComm.java:339) — gang
+members are mutually trusted; never point this at untrusted endpoints.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from harp_tpu.parallel.events import Event, EventQueue, EventType
+
+_LEN = struct.Struct(">Q")
+_KV_PREFIX = "harp/p2p/"
+
+
+def _kv_client():
+    """The jax.distributed coordination-service client, if a gang is up."""
+    try:
+        from jax._src import distributed as _jd
+
+        return _jd.global_state.client
+    except Exception:
+        return None
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(n - got)
+        if not chunk:
+            return None              # peer closed mid-frame
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+class P2PTransport:
+    """Per-process P2P endpoint: one listening server, pooled outbound conns.
+
+    Received messages land asynchronously in ``event_queue`` as MESSAGE
+    events. ``peers`` maps rank -> (host, port); omit it to rendezvous via
+    the jax.distributed key-value store (requires an initialized gang).
+    """
+
+    def __init__(self, event_queue: EventQueue, rank: int,
+                 peers: Optional[Dict[int, Tuple[str, int]]] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 retries: int = 3, retry_sleep_s: float = 0.1,
+                 connect_timeout_s: float = 30.0):
+        self.queue = event_queue
+        self.rank = rank
+        self._explicit_peers = peers is not None
+        self._peers: Dict[int, Tuple[str, int]] = dict(peers or {})
+        self._conns: Dict[int, socket.socket] = {}
+        self._accepted: set = set()
+        self._lock = threading.Lock()
+        self._send_locks: Dict[int, threading.Lock] = {}
+        self._retries = retries
+        self._retry_sleep_s = retry_sleep_s
+        self._connect_timeout_s = connect_timeout_s
+        self._closed = False
+        # Server.java:40 — one listening socket per worker; the reference
+        # derived port = 12800 + workerID (Constant.java:60), here the OS
+        # assigns one and the rendezvous publishes it
+        self._server = socket.create_server((host, port))
+        self.address: Tuple[str, int] = (host, self._server.getsockname()[1])
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"harp-p2p-accept-{rank}")
+        self._accept_thread.start()
+        if not self._explicit_peers:
+            client = _kv_client()
+            if client is not None:
+                client.key_value_set(f"{_KV_PREFIX}{self.rank}",
+                                     f"{self.address[0]}:{self.address[1]}")
+
+    # ------------------------------------------------------------------ #
+    # receive side (Server/Acceptor parity)
+    # ------------------------------------------------------------------ #
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return               # server socket closed — shutdown
+            with self._lock:
+                self._accepted.add(conn)
+            threading.Thread(target=self._reader, args=(conn,), daemon=True,
+                             name=f"harp-p2p-reader-{self.rank}").start()
+
+    def _reader(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                while True:
+                    head = _recv_exact(conn, _LEN.size)
+                    if head is None:
+                        return
+                    body = _recv_exact(conn, _LEN.unpack(head)[0])
+                    if body is None:
+                        return
+                    try:
+                        source, payload = pickle.loads(body)
+                    except Exception:
+                        # an undecodable payload (e.g. a class missing on
+                        # this member — gang version skew) must not kill the
+                        # reader: the frame boundary is intact, so log and
+                        # keep the connection alive for the next frame
+                        import logging
+
+                        logging.getLogger("harp_tpu.p2p").exception(
+                            "dropping undecodable p2p frame (%d bytes)",
+                            len(body))
+                        continue
+                    self.queue.put(Event(EventType.MESSAGE, source, payload))
+        except OSError:
+            return                   # closed under us during shutdown
+        finally:
+            with self._lock:
+                self._accepted.discard(conn)
+
+    # ------------------------------------------------------------------ #
+    # send side (SyncClient/ConnPool parity)
+    # ------------------------------------------------------------------ #
+
+    def _resolve(self, dest: int) -> Tuple[str, int]:
+        with self._lock:
+            if dest in self._peers:
+                return self._peers[dest]
+        if self._explicit_peers:
+            raise KeyError(f"worker {dest} not in the explicit peer map "
+                           f"{sorted(self._peers)}")
+        client = _kv_client()
+        if client is None:
+            raise KeyError(
+                f"worker {dest} unknown and no jax.distributed gang is "
+                f"initialized to rendezvous through")
+        val = client.blocking_key_value_get(
+            f"{_KV_PREFIX}{dest}", int(self._connect_timeout_s * 1000))
+        host, port_s = val.rsplit(":", 1)
+        addr = (host, int(port_s))
+        with self._lock:
+            self._peers[dest] = addr
+        return addr
+
+    @staticmethod
+    def _conn_is_stale(conn: socket.socket) -> bool:
+        """The receive side never writes on this protocol, so a readable
+        client socket can only mean EOF or RST — a dead pooled connection."""
+        import select
+
+        readable, _, _ = select.select([conn], [], [], 0)
+        return bool(readable)
+
+    def _dest_lock(self, dest: int) -> threading.Lock:
+        with self._lock:
+            lk = self._send_locks.get(dest)
+            if lk is None:
+                lk = self._send_locks[dest] = threading.Lock()
+        return lk
+
+    def send(self, dest: int, payload) -> None:
+        """Deliver ``payload`` to ``dest``'s event queue. Touches only this
+        process and ``dest`` — no gang synchronization. Retries with a fresh
+        connection on socket failure (SMALL_RETRY_COUNT parity, scaled to
+        control-plane rates). Thread-safe: sends to the same dest are
+        serialized on a per-dest lock so concurrent frames never interleave
+        on the pooled connection."""
+        if self._closed:
+            raise ConnectionError("transport is closed")
+        if dest == self.rank:
+            self.queue.put(Event(EventType.MESSAGE, self.rank, payload))
+            return
+        body = pickle.dumps((self.rank, payload))
+        frame = _LEN.pack(len(body)) + body
+        with self._dest_lock(dest):
+            self._send_framed(dest, frame)
+
+    def _send_framed(self, dest: int, frame: bytes) -> None:
+        last: Optional[Exception] = None
+        for attempt in range(self._retries):
+            try:
+                with self._lock:
+                    conn = self._conns.get(dest)
+                if conn is not None and self._conn_is_stale(conn):
+                    # a graceful peer close (FIN) would otherwise let ONE
+                    # sendall "succeed" into the void before the RST —
+                    # detect it up front so the retry path reconnects
+                    raise OSError("pooled connection closed by peer")
+                if conn is None:
+                    conn = socket.create_connection(
+                        self._resolve(dest), timeout=self._connect_timeout_s)
+                    with self._lock:
+                        self._conns[dest] = conn
+                conn.sendall(frame)
+                return
+            except OSError as e:
+                last = e
+                with self._lock:
+                    stale = self._conns.pop(dest, None)
+                if stale is not None:
+                    try:
+                        stale.close()
+                    except OSError:
+                        pass
+                if attempt + 1 < self._retries:
+                    time.sleep(self._retry_sleep_s)
+        raise ConnectionError(
+            f"p2p send to worker {dest} failed after {self._retries} "
+            f"attempts") from last
+
+    # ------------------------------------------------------------------ #
+
+    def close(self) -> None:
+        """Stop accepting and drop pooled connections (ConnPool.clean +
+        server.stop, CollectiveMapper teardown :783-788)."""
+        self._closed = True
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns.values()) + list(self._accepted)
+            self._conns.clear()
+            self._accepted.clear()
+        for c in conns:
+            try:
+                # shutdown (not just close) wakes any reader thread blocked
+                # in recv on this socket and puts the FIN on the wire NOW —
+                # close() alone defers teardown while a recv holds the fd
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def __enter__(self) -> "P2PTransport":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
