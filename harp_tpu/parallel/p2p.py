@@ -53,6 +53,35 @@ def _kv_client():
         return None
 
 
+def _routable_host() -> str:
+    """An address peers on other hosts can reach: the interface this process
+    would use toward the gang coordinator (a connectionless UDP connect —
+    nothing is sent), falling back to the hostname's address, then loopback
+    for coordinator-less single-host runs."""
+    coord = None
+    try:
+        from jax._src import distributed as _jd
+
+        coord = _jd.global_state.coordinator_address
+    except Exception:
+        pass
+    if coord:
+        try:
+            host = coord.rsplit(":", 1)[0]
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                s.connect((host, 1))
+                return s.getsockname()[0]
+        except OSError:
+            pass
+    try:
+        addr = socket.gethostbyname(socket.gethostname())
+        if not addr.startswith("127."):
+            return addr
+    except OSError:
+        pass
+    return "127.0.0.1"
+
+
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     chunks = []
     got = 0
@@ -75,7 +104,8 @@ class P2PTransport:
 
     def __init__(self, event_queue: EventQueue, rank: int,
                  peers: Optional[Dict[int, Tuple[str, int]]] = None,
-                 host: str = "127.0.0.1", port: int = 0,
+                 host: str = "0.0.0.0", port: int = 0,
+                 advertise_host: Optional[str] = None,
                  retries: int = 3, retry_sleep_s: float = 0.1,
                  connect_timeout_s: float = 30.0):
         self.queue = event_queue
@@ -92,9 +122,15 @@ class P2PTransport:
         self._closed = False
         # Server.java:40 — one listening socket per worker; the reference
         # derived port = 12800 + workerID (Constant.java:60), here the OS
-        # assigns one and the rendezvous publishes it
+        # assigns one and the rendezvous publishes it. Bind all interfaces
+        # by default but ADVERTISE a routable address — publishing the bind
+        # host would hand multi-host peers 0.0.0.0/loopback
         self._server = socket.create_server((host, port))
-        self.address: Tuple[str, int] = (host, self._server.getsockname()[1])
+        bound_port = self._server.getsockname()[1]
+        if advertise_host is None:
+            advertise_host = (host if host not in ("0.0.0.0", "")
+                              else _routable_host())
+        self.address: Tuple[str, int] = (advertise_host, bound_port)
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True,
             name=f"harp-p2p-accept-{rank}")

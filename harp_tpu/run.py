@@ -800,8 +800,66 @@ def run_apriori(argv) -> int:
     return 0
 
 
+def run_sgxsimu(argv) -> int:
+    """experimental/kmeans/sgxsimu parity: K-means with modeled trusted-
+    enclave (SGX/TEE) overheads (KMeansLauncher.java of that package)."""
+    from harp_tpu.models.kmeans import KMeansConfig
+
+    p = argparse.ArgumentParser(prog="harp_tpu.run sgxsimu")
+    _common_flags(p)
+    p.add_argument("--num-points", type=int, default=20000)
+    p.add_argument("--enclave-total-mb", type=int, default=96,
+                   help="total enclave capacity (reference ENCLAVE_TOTAL)")
+    p.add_argument("--enclave-per-thd-mb", type=int, default=96,
+                   help="effective enclave per thread (ENCLAVE_PER_THD)")
+    p.add_argument("--threads-per-worker", type=int, default=1)
+    p.add_argument("--page-swap", action="store_true",
+                   help="include the page-swap term the reference defines "
+                        "but ships commented out")
+    p.add_argument("--simulate", action="store_true",
+                   help="sleep the modeled overheads so the wall clock "
+                        "shows the enclave-cost shape (simuOverhead parity)")
+    _add_config_flags(p, KMeansConfig)
+    args = p.parse_args(argv)
+    sess = _session(args)
+    import numpy as np
+
+    from harp_tpu.io import datagen
+    from harp_tpu.models import kmeans as km
+    from harp_tpu.models.sgxsimu import SGXSimuConfig, SGXSimuKMeans
+
+    cfg = _config_from_args(km.KMeansConfig, args)
+    pts = datagen.dense_points(args.num_points, cfg.dim, seed=args.seed,
+                               num_clusters=cfg.num_centroids)
+    pts = pts[: len(pts) - len(pts) % sess.num_workers]
+    cen0 = datagen.initial_centroids(pts, cfg.num_centroids, seed=args.seed + 1)
+    simu = SGXSimuConfig(enclave_total_mb=args.enclave_total_mb,
+                         enclave_per_thd_mb=args.enclave_per_thd_mb,
+                         threads_per_worker=args.threads_per_worker,
+                         include_page_swap=args.page_swap)
+    t0 = time.perf_counter()
+    cen, costs, rep = SGXSimuKMeans(sess, cfg, simu).fit(
+        pts, cen0, simulate=args.simulate)
+    dt = time.perf_counter() - t0
+    # the reference's five LOG.info totals (KMeansCollectiveMapper.java:368)
+    print(f"sgxsimu workers={sess.num_workers} n={len(pts)} "
+          f"k={cfg.num_centroids} d={cfg.dim}: "
+          f"init {rep['init_ms']:.1f} ms; per-iter ecall "
+          f"{rep['comp_ecall_ms_per_iter']:.3f} / ocall "
+          f"{rep['comp_ocall_ms_per_iter']:.3f} / swap "
+          f"{rep['comp_swap_ms_per_iter']:.3f} / comm "
+          f"{rep['comm_ms_per_iter']:.3f} ms; clean "
+          f"{rep['clean_ms_per_iter']:.3f} ms/iter -> modeled slowdown "
+          f"{rep['modeled_slowdown']:.2f}x"
+          f"{' (simulated in wall clock)' if args.simulate else ''}; "
+          f"cost {np.asarray(costs)[0]:.1f} -> {np.asarray(costs)[-1]:.1f} "
+          f"in {dt:.1f}s")
+    return 0
+
+
 COMMANDS = {
     "kmeans": run_kmeans,
+    "sgxsimu": run_sgxsimu,
     "sgd_mf": run_sgd_mf,
     "lda": run_lda,
     "pca": run_pca,

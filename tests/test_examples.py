@@ -133,6 +133,9 @@ import pytest
       "--num-classes", "2"], "ll"),
     (["apriori", "--num-transactions", "512", "--num-items", "16"],
      "frequent itemsets"),
+    (["sgxsimu", "--num-points", "2048", "--num-centroids", "8", "--dim",
+      "16", "--iterations", "4", "--page-swap", "--enclave-per-thd-mb", "1",
+      "--simulate"], "modeled slowdown"),
 ])
 def test_run_family_cli(args, expect):
     out = _run_cmd(args)

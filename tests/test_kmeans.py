@@ -103,3 +103,65 @@ def test_kmeans_fit_checkpointed_resume_equivalence(session, tmp_path):
     assert start_r == 4 and len(costs_r) == 2
     np.testing.assert_array_equal(np.asarray(cen_full), np.asarray(cen_r))
     np.testing.assert_array_equal(np.asarray(costs_full)[4:], costs_r)
+
+
+# --- sgxsimu (experimental/kmeans/sgxsimu parity) -------------------------- #
+
+def test_sgxsimu_cost_model_buckets():
+    from harp_tpu.models.sgxsimu import (SGXCostConstants, SGXSimuConfig,
+                                         model_kmeans_overheads)
+
+    c = SGXCostConstants()
+    cfg = SGXSimuConfig(threads_per_worker=2)
+    m = model_kmeans_overheads(n_points=8192, dim=16, k=8, workers=4,
+                               iterations=10, cfg=cfg)
+    # buckets are PER WORKER (reference mappers sleep their own overheads
+    # concurrently): creation per thread + attestation pairings
+    # C(2,2->1)+(W-1)*thr, no gang-wide multiplier
+    creation = 2 * c.ms(c.creation_enclave_fix
+                        + 96 * 1024 * c.creation_enclave_kb)
+    pairings = 1 + 3 * 2
+    attest = c.ms(pairings * c.local_attestation)
+    assert abs(m["init_ms"] - (creation + attest)) < 1e-9
+    # comm: 2 collectives * (Ocall + Ecall*(W-1) + cen_kb * per_kb)
+    cen_kb = 8 * 17 * 8 / 1024
+    per_coll = c.ms(c.ocall + c.ecall * 3) + c.ms(cen_kb * c.cross_enclave_per_kb)
+    assert abs(m["comm_ms_per_iter"] - 2 * per_coll) < 1e-9
+    assert m["comp_swap_ms_per_iter"] == 0.0          # opt-in term
+    assert m["total_overhead_ms"] == (
+        m["init_ms"] + 10 * m["overhead_ms_per_iter"])
+    assert m["gang_total_overhead_ms"] == 4 * m["total_overhead_ms"]
+
+
+def test_sgxsimu_page_swap_activates_below_working_set():
+    from harp_tpu.models.sgxsimu import SGXSimuConfig, model_kmeans_overheads
+
+    big = model_kmeans_overheads(65536, 64, 16, 4, 5,
+                                 SGXSimuConfig(include_page_swap=True,
+                                               enclave_per_thd_mb=1))
+    roomy = model_kmeans_overheads(65536, 64, 16, 4, 5,
+                                   SGXSimuConfig(include_page_swap=True,
+                                                 enclave_per_thd_mb=96))
+    assert big["comp_swap_ms_per_iter"] > 0.0
+    assert roomy["comp_swap_ms_per_iter"] == 0.0
+
+
+def test_sgxsimu_fit_matches_plain_kmeans(session):
+    from harp_tpu.models.sgxsimu import SGXSimuKMeans
+
+    pts = datagen.dense_points(1024, 8, seed=0, num_clusters=4)
+    cen0 = datagen.initial_centroids(pts, 4, seed=1)
+    cfg = km.KMeansConfig(4, 8, iterations=5)
+    cen_plain, costs_plain = km.KMeans(session, cfg).fit(pts, cen0)
+    cen_sgx, costs_sgx, rep = SGXSimuKMeans(session, cfg).fit(pts, cen0)
+    np.testing.assert_array_equal(np.asarray(cen_plain), cen_sgx)
+    np.testing.assert_array_equal(np.asarray(costs_plain), costs_sgx)
+    assert rep["modeled_slowdown"] > 1.0
+    assert rep["init_ms"] > 0 and rep["comm_ms_per_iter"] > 0
+    # simulate=True runs per-iteration compiled chunks with sleeps between;
+    # Lloyd chunking is bitwise the full scan, so results are unchanged
+    cen_sim, costs_sim, rep_sim = SGXSimuKMeans(session, cfg).fit(
+        pts, cen0, simulate=True)
+    np.testing.assert_array_equal(cen_sim, cen_sgx)
+    np.testing.assert_array_equal(costs_sim, costs_sgx)
+    assert rep_sim["simulated_ms_per_iter"] >= rep_sim["clean_ms_per_iter"]
