@@ -10,6 +10,18 @@ for its shard of users (then items) as one batched Cholesky solve on the MXU, an
 one all_gather re-replicates the updated factor — DAAL's step1-4 dance collapses
 to "batched local solve + allgather".
 
+Duplicate (row, col) pairs are dropped (keep-first) in ``prepare`` for BOTH
+layouts so the two paths always train on the identical entry set (the
+sgd_mf contract); the count is in ``last_layout_stats["duplicates_dropped"]``.
+
+Dual layout (the dense-SGD-MF pattern applied to ALS): ``layout="dense"``
+stores the rating matrix as NaN-encoded bf16 planes and computes each side's
+normal equations as two big GEMMs (conf @ VV and a weighted @ V) instead of
+per-entry factor-row gathers (128-byte granules, the TPU sparse-access wall);
+auto-selected when both planes fit HARP_ALS_DENSE_MAX_BYTES. Either way the
+batched k×k solve dominates on TPU — see ALSConfig.solver for the measured
+story.
+
 Sparse layout (SURVEY §7 recipe, skew-robust): ragged observed-entry lists become
 **capped chunks** — a row's entries split into chunks of at most
 ``chunk_factor × mean`` entries, each chunk computing a partial Gram/RHS that a
@@ -44,6 +56,27 @@ class ALSConfig:
     implicit: bool = True
     balance: bool = True        # serpentine-LPT row→worker assignment
     chunk_factor: float = 2.0   # chunk cap = ceil(chunk_factor * mean entries)
+    solver: str = "auto"        # auto | cholesky | newton — how the batched
+    #   k×k SPD normal equations are solved. The solve DOMINATES ALS on TPU
+    #   (measured ablation, PERF.md r3: the bench iteration is 70 ms with
+    #   the solve and 9.6 ms without): batched 32×32 operands underfill the
+    #   128-lane MXU, so every algorithm plateaus near ~0.7 TFLOP/s —
+    #   Cholesky ≈ Newton–Schulz inverse iteration ≈ 30 ms per (8192, 32,
+    #   32)-batch solve pair, and 4×-block-diagonal packing is 5× WORSE
+    #   (triangular-solve cost scales with the serial k). "auto" = cholesky
+    #   (exact, and as fast as anything measured); "newton" (pure batched
+    #   GEMMs, Precision.HIGHEST — TPU's default bf16 multiply floors its
+    #   quadratic convergence at ~1e-1) is kept as the measured alternative.
+    newton_iters: int = 30
+    layout: str = "auto"        # auto | dense | sparse — "dense" stores the
+    #   rating matrix as NaN-encoded bf16 planes and computes each side's
+    #   normal equations as two big GEMMs (conf @ VV and weighted @ V): the
+    #   sparse path's factor-row gathers are 128 B granules (~25M rows/s,
+    #   the same wall dense SGD-MF hit), while the dense A-GEMM runs the
+    #   MXU at matrix-matrix rates. "auto" picks dense when this worker's
+    #   share of the two planes fits dense_max_bytes
+    dense_max_bytes: int = 2 * 1024 ** 3  # per-WORKER budget for the two
+    #   bf16 plane shards (the SGDMFConfig.dense_max_bytes convention)
 
 
 def pad_csr_lists(rows, cols, vals, num_rows, num_workers):
@@ -124,6 +157,49 @@ def pad_csr_chunks(rows, cols, vals, num_rows, num_workers,
     return idx, val, mask, chunk_row, (row_bin, row_slot), rpw, stats
 
 
+def _resolve_solver(cfg: ALSConfig) -> str:
+    if cfg.solver not in ("auto", "cholesky", "newton"):
+        raise ValueError(f"solver must be auto|cholesky|newton, got "
+                         f"{cfg.solver!r}")
+    if cfg.solver != "auto":
+        return cfg.solver
+    # measured on v5e (PERF.md r3): cholesky ties or beats newton at every
+    # batch shape tried, and is exact — it wins everywhere
+    return "cholesky"
+
+
+def _spd_solve(a, b, cfg: ALSConfig):
+    """Solve the batched SPD systems ``a @ x = b`` (a: (N, K, K), b: (N, K)).
+
+    newton: X_{t+1} = X_t (2I − A X_t) from X_0 = I / ||A||_inf — for SPD A
+    the row-sum norm bounds λ_max, so ||I − X_0 A||_2 = 1 − λ_min/||A||_inf
+    < 1 and the error squares every round: ~log2(cond) + 5 rounds reach f32
+    accuracy (30 rounds cover cond ≤ ~3e7; ALS regularizes with λI so cond
+    ≤ λ_max/λ). Every op is a batched GEMM — but measured on v5e this buys
+    nothing over Cholesky: batched (8192, 32, 32) operands underfill the
+    MXU for both, ~30 ms per solve pair either way (ALSConfig.solver note,
+    PERF.md r3). Kept as the measured alternative and for platforms where
+    batched triangular solves lower worse."""
+    if _resolve_solver(cfg) == "cholesky":
+        return jax.scipy.linalg.solve(a, b[..., None], assume_a="pos")[..., 0]
+    k = a.shape[-1]
+    eye = jnp.eye(k, dtype=a.dtype)
+    norminf = jnp.max(jnp.sum(jnp.abs(a), axis=-1), axis=-1)
+    x = (1.0 / norminf)[..., None, None] * eye
+    # full f32 multiply precision is LOAD-BEARING here: TPU's default
+    # bf16-multiply f32 matmul floors the NS error at ~1e-1 (measured — the
+    # iteration squares its error each round, so truncation noise persists)
+    hi = jax.lax.Precision.HIGHEST
+
+    def step(x, _):
+        ax = jnp.matmul(a, x, precision=hi)
+        x = jnp.matmul(x, 2.0 * eye - ax, precision=hi)
+        return x, ()
+
+    x, _ = jax.lax.scan(step, x, None, length=cfg.newton_iters)
+    return jnp.matmul(x, b[..., None], precision=hi)[..., 0]
+
+
 def _half_step(factor_other, idx, val, mask, chunk_row, rpw: int,
                cfg: ALSConfig):
     """Solve this worker's block of one side's normal equations.
@@ -151,7 +227,7 @@ def _half_step(factor_other, idx, val, mask, chunk_row, rpw: int,
             preferred_element_type=jnp.float32)
         a = a + gram[None]
     a = a + cfg.lam * jnp.eye(k, dtype=a.dtype)[None]
-    return jax.scipy.linalg.solve(a, b[..., None], assume_a="pos")[..., 0]
+    return _spd_solve(a, b, cfg)
 
 
 def _train(u_data, i_data, u0, v0, u_rpw: int, i_rpw: int, cfg: ALSConfig,
@@ -172,6 +248,76 @@ def _train(u_data, i_data, u0, v0, u_rpw: int, i_rpw: int, cfg: ALSConfig,
         tgt = u_val if not cfg.implicit else (u_mask * 1.0)
         sse = jax.lax.psum(jnp.sum(u_mask * (tgt - pred) ** 2), axis_name)
         cnt = jax.lax.psum(jnp.sum(u_mask), axis_name)
+        return (u, v), jnp.sqrt(sse / jnp.maximum(cnt, 1.0))
+
+    (u, v), rmse = jax.lax.scan(iteration, (u0, v0), None,
+                                length=cfg.iterations)
+    return u, v, rmse
+
+
+# --------------------------------------------------------------------------- #
+# Dense layout: normal equations as GEMMs (the dense-SGD-MF trick for ALS)
+# --------------------------------------------------------------------------- #
+
+def _half_step_dense(factor_other, val_plane, rpw: int, cfg: ALSConfig):
+    """One side's normal equations from a dense NaN-encoded value plane.
+
+    ``val_plane``: (rpw, E_other) bf16, NaN = unobserved (0 is a VALID
+    observed value in explicit mode). A_u = Σ_i w_ui v_i v_iᵀ collapses to
+    one (rpw, E) @ (E, K²) GEMM against the factor's row-wise outer products
+    — MXU matrix-matrix rates instead of 128-byte row gathers. bf16 operands,
+    f32 accumulation (the dense SGD-MF precision contract)."""
+    k = cfg.rank
+    obs = jnp.isfinite(val_plane)
+    vz = jnp.where(obs, val_plane, 0).astype(jnp.bfloat16)
+    f_b = factor_other.astype(jnp.bfloat16)
+    e = factor_other.shape[0]
+    vv = (f_b[:, :, None] * f_b[:, None, :]).reshape(e, k * k)
+    f32 = jnp.float32
+    if cfg.implicit:
+        # Hu-Koren: A = V'V + V'(C−I)V + λI, C−I = alpha*r on observed
+        conf = (cfg.alpha * vz).astype(jnp.bfloat16)
+        a = jax.lax.dot_general(conf, vv, (((1,), (0,)), ((), ())),
+                                preferred_element_type=f32)
+        gram = jax.lax.dot_general(factor_other, factor_other,
+                                   (((0,), (0,)), ((), ())),
+                                   preferred_element_type=f32)
+        a = a.reshape(rpw, k, k) + gram[None]
+        bw = jnp.where(obs, 1.0 + cfg.alpha * vz.astype(f32), 0.0)
+        b = jax.lax.dot_general(bw.astype(jnp.bfloat16), f_b,
+                                (((1,), (0,)), ((), ())),
+                                preferred_element_type=f32)
+    else:
+        a = jax.lax.dot_general(obs.astype(jnp.bfloat16), vv,
+                                (((1,), (0,)), ((), ())),
+                                preferred_element_type=f32)
+        a = a.reshape(rpw, k, k)
+        b = jax.lax.dot_general(vz, f_b, (((1,), (0,)), ((), ())),
+                                preferred_element_type=f32)
+    a = a + cfg.lam * jnp.eye(k, dtype=a.dtype)[None]
+    return _spd_solve(a, b, cfg)
+
+
+def _train_dense(u_plane, i_plane, u0, v0, u_rpw: int, i_rpw: int,
+                 cfg: ALSConfig, axis_name: str = WORKERS):
+    """Dense-layout training loop: same allgather choreography as _train,
+    with the dense half-step and a GEMM-based RMSE monitor."""
+
+    def iteration(carry, _):
+        u, v = carry
+        u_block = _half_step_dense(v, u_plane, u_rpw, cfg)
+        u = lax_ops.allgather(u_block, axis_name)
+        v_block = _half_step_dense(u, i_plane, i_rpw, cfg)
+        v = lax_ops.allgather(v_block, axis_name)
+        obs = jnp.isfinite(u_plane)
+        pred = jax.lax.dot_general(
+            u_block.astype(jnp.bfloat16), v.astype(jnp.bfloat16),
+            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        tgt = (jnp.where(obs, u_plane, 0).astype(jnp.float32)
+               if not cfg.implicit else 1.0)
+        sse = jax.lax.psum(jnp.sum(jnp.where(obs, (tgt - pred) ** 2, 0.0)),
+                           axis_name)
+        cnt = jax.lax.psum(jnp.sum(obs.astype(jnp.float32)), axis_name)
         return (u, v), jnp.sqrt(sse / jnp.maximum(cnt, 1.0))
 
     (u, v), rmse = jax.lax.scan(iteration, (u0, v0), None,
@@ -209,6 +355,20 @@ class ALS:
                 "implicit ALS requires nonnegative interaction values "
                 f"(confidence counts); got min {vals.min():.4f} — use "
                 "implicit=False for signed ratings, or feed counts")
+        # keep-first dedupe for BOTH layouts so they train on the identical
+        # entry set (the sgd_mf.prepare contract; the sparse path would
+        # otherwise SUM duplicates while the dense plane kept one)
+        self._duplicates_dropped = 0
+        if len(rows):
+            keys = rows.astype(np.int64) * num_items + cols
+            _, first = np.unique(keys, return_index=True)
+            if len(first) != len(rows):
+                self._duplicates_dropped = len(rows) - len(first)
+                first.sort()
+                rows, cols, vals = rows[first], cols[first], vals[first]
+        if self._pick_layout(num_users, num_items) == "dense":
+            return self._prepare_dense(rows, cols, vals, num_users,
+                                       num_items, seed)
         u_layout = pad_csr_chunks(rows, cols, vals, num_users, w,
                                   cfg.chunk_factor, cfg.balance)
         i_layout = pad_csr_chunks(cols, rows, vals, num_items, w,
@@ -216,8 +376,10 @@ class ALS:
         u_idx, u_val, u_mask, u_crow, u_assign, u_rpw, u_stats = u_layout
         i_idx, i_val, i_mask, i_crow, i_assign, i_rpw, i_stats = i_layout
         self.last_layout_stats = {
+            "layout": "sparse",
             "users": u_stats, "items": i_stats,
             "overhead": max(u_stats["overhead"], i_stats["overhead"]),
+            "duplicates_dropped": self._duplicates_dropped,
         }
         # chunk idx entries address the OTHER side's replicated factor, which
         # lives in permuted slot order after allgather — remap on the host
@@ -256,6 +418,67 @@ class ALS:
                   sess.scatter(i_mask), sess.scatter(i_crow),
                   sess.replicate_put(u0), sess.replicate_put(v0))
         return key, placed, u_slots, v_slots
+
+    def _pick_layout(self, num_users: int, num_items: int) -> str:
+        cfg = self.config
+        if cfg.layout not in ("auto", "dense", "sparse"):
+            raise ValueError(f"layout must be auto|dense|sparse, got "
+                             f"{cfg.layout!r}")
+        if cfg.layout != "auto":
+            return cfg.layout
+        w = self.session.num_workers
+        u_rpw = -(-num_users // w)
+        i_rpw = -(-num_items // w)
+        # each worker holds one (u_rpw, i_pad) and one (i_rpw, u_pad) bf16
+        # shard — the budget is per-worker HBM, so dense stays available on
+        # big meshes where the global planes dwarf a single chip
+        per_worker = (u_rpw * (i_rpw * w) + i_rpw * (u_rpw * w)) * 2
+        return "dense" if per_worker <= cfg.dense_max_bytes else "sparse"
+
+    def _prepare_dense(self, rows, cols, vals, num_users: int,
+                       num_items: int, seed: int):
+        """Dense NaN-encoded plane layout (see ALSConfig.layout). Entries
+        arrive already deduped (keep-first, prepare's contract). Factor rows
+        stay in natural entity order (no slot permutation); padding rows sit
+        past num_users/num_items and are zeroed so the implicit gram V'V is
+        unbiased."""
+        import ml_dtypes
+
+        sess, cfg = self.session, self.config
+        w = sess.num_workers
+        u_rpw = -(-num_users // w)
+        i_rpw = -(-num_items // w)
+        u_pad, i_pad = w * u_rpw, w * i_rpw
+        # build straight in bf16 (host peak = exactly the budgeted bytes);
+        # entries are already deduped, and the item plane is the transpose
+        # by construction — no second fill pass
+        u_plane = np.full((u_pad, i_pad), np.nan, ml_dtypes.bfloat16)
+        u_plane[rows, cols] = vals.astype(ml_dtypes.bfloat16)
+        i_plane = np.ascontiguousarray(u_plane.T)
+        self.last_layout_stats = {
+            "layout": "dense",
+            "plane_bytes": 2 * u_pad * i_pad * 2,
+            "duplicates_dropped": self._duplicates_dropped,
+            "overhead": (u_pad * i_pad) / max(len(rows), 1),
+        }
+        rng = np.random.default_rng(seed)
+        scale = 1.0 / np.sqrt(cfg.rank)
+        u0 = (scale * rng.random((u_pad, cfg.rank))).astype(np.float32)
+        v0 = (scale * rng.random((i_pad, cfg.rank))).astype(np.float32)
+        u0[num_users:] = 0.0
+        v0[num_items:] = 0.0
+        key = ("dense", u_rpw, i_rpw, w, cfg.implicit)
+        if key not in self._fns:
+            self._fns[key] = sess.spmd(
+                lambda up, ip, u, v: _train_dense(up, ip, u, v, u_rpw,
+                                                  i_rpw, cfg),
+                in_specs=(sess.shard(), sess.shard(),
+                          sess.replicate(), sess.replicate()),
+                out_specs=(sess.replicate(),) * 3)
+        placed = (sess.scatter(jnp.asarray(u_plane, jnp.bfloat16)),
+                  sess.scatter(jnp.asarray(i_plane, jnp.bfloat16)),
+                  sess.replicate_put(u0), sess.replicate_put(v0))
+        return (key, placed, np.arange(num_users), np.arange(num_items))
 
     def train_prepared(self, state):
         """Run the compiled train program; factors stay ON DEVICE. Returns

@@ -25,7 +25,8 @@ def test_als_zipf_bounded_padding_and_converges(session):
     rows, cols, vals = datagen.zipf_ratings(
         num_users=256, num_items=192, rank=4, alpha=1.3, density=0.08, seed=9,
         noise=0.01)
-    cfg = als.ALSConfig(rank=8, lam=0.05, iterations=8, implicit=False)
+    cfg = als.ALSConfig(rank=8, lam=0.05, iterations=8, implicit=False,
+                        layout="sparse")     # this test is ABOUT the chunks
     model = als.ALS(session, cfg)
     u, v, rmse = model.fit(rows, cols, vals, 256, 192)
     assert model.last_layout_stats["overhead"] <= 4.0
@@ -126,3 +127,53 @@ def test_lbfgs_beats_sgd_on_iterations(session):
     _, loss_s = solvers.Solver(session, "sgd", cfg_s).minimize(
         solvers.mse_objective, x, y, np.zeros(12, np.float32))
     assert loss_l[-1] < loss_s[-1]
+
+
+def test_als_dense_sparse_layout_parity(session):
+    """The dense NaN-encoded GEMM layout converges to the same quality as
+    the capped-chunk sparse layout in both modes (bf16 planes with f32
+    accumulation — the dense SGD-MF precision contract)."""
+    import dataclasses as _dc
+
+    rows, cols, vals = datagen.sparse_ratings(128, 96, rank=6, density=0.08,
+                                              seed=11, noise=0.01)
+    for implicit in (False, True):
+        v_in = np.abs(vals) if implicit else vals
+        finals = {}
+        for layout in ("sparse", "dense"):
+            cfg = als.ALSConfig(rank=12, lam=0.1, alpha=20.0, iterations=8,
+                                implicit=implicit, layout=layout)
+            m = als.ALS(session, cfg)
+            _, _, rmse = m.fit(rows, cols, v_in, 128, 96, seed=0)
+            finals[layout] = float(rmse[-1])
+        if implicit:
+            stats = m.last_layout_stats
+            assert stats["layout"] == "dense"
+            # BOTH layouts dedupe keep-first in prepare (sgd_mf contract) and
+            # report the count — identical training sets by construction
+            n_unique = len({(int(r), int(c)) for r, c in zip(rows, cols)})
+            assert stats["duplicates_dropped"] == len(rows) - n_unique
+        assert abs(finals["dense"] - finals["sparse"]) < 0.05 * max(
+            abs(finals["sparse"]), 0.02), (implicit, finals)
+
+
+def test_als_auto_layout_threshold(session):
+    """auto picks dense when this worker's plane shards fit dense_max_bytes,
+    sparse when they do not; the budget is per-worker, so a wider mesh keeps
+    dense available at sizes whose GLOBAL planes exceed it."""
+    import dataclasses as _dc
+
+    cfg = als.ALSConfig(rank=4, iterations=1)
+    m = als.ALS(session, cfg)
+    assert m._pick_layout(64, 64) == "dense"
+    tight = als.ALS(session, _dc.replace(cfg, dense_max_bytes=1024))
+    assert tight._pick_layout(64, 64) == "sparse"
+    # per-worker budgeting: global planes for 4096² are 64 MiB > an 8 MiB
+    # budget, but an 8-worker mesh's per-worker share (8 MiB) just fits
+    w = session.num_workers
+    per_worker = (4096 // w) * 4096 * 2 * 2
+    roomy = als.ALS(session, _dc.replace(cfg, dense_max_bytes=per_worker))
+    assert roomy._pick_layout(4096, 4096) == "dense"
+    assert als.ALS(session, _dc.replace(
+        cfg, dense_max_bytes=per_worker - 1))._pick_layout(4096, 4096) == \
+        "sparse"
