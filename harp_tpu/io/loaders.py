@@ -51,38 +51,63 @@ def load_dense_csv(paths: Sequence[str], num_threads: int = 4,
     Returns the row-concatenation of all files, in path order.
     """
     paths = list(paths)
-    order = {p: i for i, p in enumerate(paths)}
     results: List[Optional[np.ndarray]] = [None] * len(paths)
 
-    class _ReadTask(Task[str, Tuple[int, np.ndarray]]):
+    class _ReadTask(Task[Tuple[int, str], Tuple[int, np.ndarray]]):
         """ReadDenseCSVTask equivalent (datasource/ReadDenseCSVTask.java)."""
 
-        def run(self, path):
-            return order[path], load_dense_csv_one(path, sep)
+        def run(self, item):
+            idx, path = item          # indexed item: duplicate paths stay
+            return idx, load_dense_csv_one(path, sep)
 
     sched = DynamicScheduler([_ReadTask() for _ in range(num_threads)])
     sched.start()
-    sched.submit_all(paths)
+    sched.submit_all(enumerate(paths))
     for idx, arr in sched.drain():
         results[idx] = arr
     sched.stop()
     return np.concatenate([r for r in results if r is not None], axis=0)
 
 
-def load_coo(paths: Sequence[str], sep: str = " ") -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """COO triple load (HarpDAALDataSource.loadCOOFiles:317): each line
-    ``row col value``. Returns (rows, cols, vals)."""
+def _load_coo_one(path: str, sep: str
+                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     from harp_tpu.io import native_bridge
 
-    rows, cols, vals = [], [], []
-    for p in paths:
-        triple = native_bridge.parse_coo(p, sep)
-        if triple is None:
-            m = np.loadtxt(p, delimiter=None if sep == " " else sep, ndmin=2)
-            triple = (m[:, 0].astype(np.int64), m[:, 1].astype(np.int64),
-                      m[:, 2].astype(np.float32))
-        rows.append(triple[0]); cols.append(triple[1]); vals.append(triple[2])
-    return (np.concatenate(rows), np.concatenate(cols), np.concatenate(vals))
+    triple = native_bridge.parse_coo(path, sep)
+    if triple is None:
+        m = np.loadtxt(path, delimiter=None if sep == " " else sep, ndmin=2)
+        triple = (m[:, 0].astype(np.int64), m[:, 1].astype(np.int64),
+                  m[:, 2].astype(np.float32))
+    return triple
+
+
+def load_coo(paths: Sequence[str], sep: str = " ", num_threads: int = 4
+             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """COO triple load (HarpDAALDataSource.loadCOOFiles:317): each line
+    ``row col value``. Returns (rows, cols, vals), concatenated in path
+    order. Files are read by the MTReader-equivalent thread pool — ctypes
+    releases the GIL, so the native per-file parsers genuinely overlap."""
+    paths = list(paths)
+    results: List[Optional[Tuple]] = [None] * len(paths)
+
+    class _ReadCOOTask(Task[Tuple[int, str], Tuple[int, Tuple]]):
+        """ReadCOOTask equivalent (datasource/ReadCOOTask.java)."""
+
+        def run(self, item):
+            idx, path = item          # indexed item: duplicate paths stay
+            return idx, _load_coo_one(path, sep)
+
+    sched = DynamicScheduler(
+        [_ReadCOOTask() for _ in range(min(num_threads, max(len(paths), 1)))])
+    sched.start()
+    sched.submit_all(enumerate(paths))
+    for idx, triple in sched.drain():
+        results[idx] = triple
+    sched.stop()
+    got = [r for r in results if r is not None]
+    return (np.concatenate([t[0] for t in got]),
+            np.concatenate([t[1] for t in got]),
+            np.concatenate([t[2] for t in got]))
 
 
 def coo_to_csr(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
@@ -90,10 +115,26 @@ def coo_to_csr(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """COO→CSR conversion (HarpDAALDataSource.COOToCSR:439).
 
-    Returns (indptr[num_rows+1], indices, values) with rows sorted ascending.
+    Returns (indptr[num_rows+1], indices, values) with rows sorted ascending
+    and each row's entries in input order (STABLE — duplicate semantics
+    upstream rely on it). Uses the native parallel counting sort
+    (O(nnz + rows), threaded) when libharp_native is built; numpy stable
+    argsort otherwise.
     """
     if num_rows is None:
         num_rows = int(rows.max()) + 1 if rows.size else 0
+    if rows.size and (rows.min() < 0 or rows.max() >= num_rows):
+        # the numpy fallback would otherwise wrap negatives into indptr[0]
+        # silently; validate up front on BOTH paths
+        raise ValueError(f"row ids must be in [0, {num_rows}); got "
+                         f"[{rows.min()}, {rows.max()}]")
+    vals = np.asarray(vals, np.float32)   # one output dtype on both paths
+    if rows.size:
+        from harp_tpu.io import native_bridge
+
+        native = native_bridge.coo_to_csr(rows, cols, vals, num_rows)
+        if native is not None:
+            return native
     order = np.argsort(rows, kind="stable")
     rows, cols, vals = rows[order], cols[order], vals[order]
     indptr = np.zeros(num_rows + 1, dtype=np.int64)

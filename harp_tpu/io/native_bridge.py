@@ -57,6 +57,12 @@ def _configure(lib: ctypes.CDLL) -> None:
                                    ctypes.POINTER(ctypes.c_longlong),
                                    ctypes.POINTER(ctypes.c_longlong),
                                    ctypes.POINTER(ctypes.c_float), ctypes.c_longlong]
+    if hasattr(lib, "harp_coo_to_csr"):   # older prebuilt .so may lack it
+        ll = ctypes.POINTER(ctypes.c_longlong)
+        fl = ctypes.POINTER(ctypes.c_float)
+        lib.harp_coo_to_csr.restype = ctypes.c_int
+        lib.harp_coo_to_csr.argtypes = [ll, ll, fl, ctypes.c_longlong,
+                                        ctypes.c_longlong, ll, ll, fl]
 
 
 def reset() -> None:
@@ -104,3 +110,30 @@ def parse_coo(path: str, sep: str = " "
                             cols.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
                             vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n)
     return (rows, cols, vals) if rc == 0 else None
+
+
+def coo_to_csr(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
+               num_rows: int
+               ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Native stable parallel counting sort (COOToCSR parity); None if the
+    library is absent, predates the symbol, or reports out-of-range rows
+    (loaders.coo_to_csr validates the range up front, so its fallback never
+    silently accepts what the native path rejected)."""
+    lib = _find_lib()
+    if lib is None or not hasattr(lib, "harp_coo_to_csr"):
+        return None
+    rows = np.ascontiguousarray(rows, np.int64)
+    cols = np.ascontiguousarray(cols, np.int64)
+    vals = np.ascontiguousarray(vals, np.float32)
+    n = len(rows)
+    indptr = np.empty(num_rows + 1, np.int64)
+    indices = np.empty(n, np.int64)
+    values = np.empty(n, np.float32)
+    ll = ctypes.POINTER(ctypes.c_longlong)
+    fl = ctypes.POINTER(ctypes.c_float)
+    rc = lib.harp_coo_to_csr(
+        rows.ctypes.data_as(ll), cols.ctypes.data_as(ll),
+        vals.ctypes.data_as(fl), n, num_rows,
+        indptr.ctypes.data_as(ll), indices.ctypes.data_as(ll),
+        values.ctypes.data_as(fl))
+    return (indptr, indices, values) if rc == 0 else None

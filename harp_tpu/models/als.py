@@ -356,16 +356,12 @@ class ALS:
                 f"(confidence counts); got min {vals.min():.4f} — use "
                 "implicit=False for signed ratings, or feed counts")
         # keep-first dedupe for BOTH layouts so they train on the identical
-        # entry set (the sgd_mf.prepare contract; the sparse path would
-        # otherwise SUM duplicates while the dense plane kept one)
-        self._duplicates_dropped = 0
-        if len(rows):
-            keys = rows.astype(np.int64) * num_items + cols
-            _, first = np.unique(keys, return_index=True)
-            if len(first) != len(rows):
-                self._duplicates_dropped = len(rows) - len(first)
-                first.sort()
-                rows, cols, vals = rows[first], cols[first], vals[first]
+        # entry set (shared sgd_mf.dedupe_coo contract; the sparse path
+        # would otherwise SUM duplicates while the dense plane kept one)
+        from harp_tpu.models.sgd_mf import dedupe_coo
+
+        rows, cols, vals, self._duplicates_dropped = dedupe_coo(
+            rows, cols, vals, num_items)
         if self._pick_layout(num_users, num_items) == "dense":
             return self._prepare_dense(rows, cols, vals, num_users,
                                        num_items, seed)

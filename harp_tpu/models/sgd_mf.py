@@ -120,6 +120,22 @@ def identity_assign(n: int, num_bins: int) -> Tuple[np.ndarray, np.ndarray]:
     return (ids // per).astype(np.int32), (ids % per).astype(np.int32)
 
 
+def dedupe_coo(rows, cols, vals, num_cols):
+    """Keep-FIRST dedupe of (row, col) pairs — the shared contract for every
+    dual-layout model (SGD-MF and ALS): sparse and dense paths must train on
+    the identical entry set, so duplicates are resolved once, here, before
+    layout dispatch. Returns (rows, cols, vals, dropped_count)."""
+    if not len(rows):
+        return rows, cols, vals, 0
+    keys = rows.astype(np.int64) * num_cols + cols
+    _, first = np.unique(keys, return_index=True)
+    if len(first) == len(rows):
+        return rows, cols, vals, 0
+    dropped = len(rows) - len(first)
+    first.sort()
+    return rows[first], cols[first], vals[first], dropped
+
+
 def _validate_coo(rows, cols, num_rows, num_cols, vals=None):
     if vals is not None and len(vals) and np.isnan(vals).any():
         raise ValueError("rating values must not be NaN (NaN encodes missing "
@@ -467,14 +483,7 @@ class SGDMF:
                              f"{cfg.layout!r}")
         _validate_coo(rows, cols, num_rows, num_cols, vals)
         # keep-first dedupe for BOTH layouts: identical training sets
-        dropped = 0
-        if len(rows):
-            keys = rows.astype(np.int64) * num_cols + cols
-            _, first = np.unique(keys, return_index=True)
-            if len(first) != len(rows):
-                dropped = len(rows) - len(first)
-                first.sort()
-                rows, cols, vals = rows[first], cols[first], vals[first]
+        rows, cols, vals, dropped = dedupe_coo(rows, cols, vals, num_cols)
         layout = self._choose_layout(num_rows, num_cols)
         if layout == "dense":
             state = self._prepare_dense(rows, cols, vals, num_rows, num_cols,

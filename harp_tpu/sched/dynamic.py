@@ -28,6 +28,13 @@ I = TypeVar("I")
 O = TypeVar("O")
 
 
+class _TaskError:
+    """Envelope carrying a worker-thread exception to the consumer."""
+
+    def __init__(self, error: BaseException):
+        self.error = error
+
+
 class Task(Generic[I, O]):
     """Harp's Task interface (schdynamic/Task.java:22: ``O run(I)``)."""
 
@@ -75,15 +82,27 @@ class DynamicScheduler(Generic[I, O]):
             item = self._in.get()
             if item is None:  # poison pill = Harp's stop signal
                 return
-            self._out.put(task.run(item))
+            try:
+                out = task.run(item)
+            except BaseException as e:          # noqa: BLE001
+                # a failing task must still produce an output slot, or every
+                # consumer counting on _submitted results blocks forever in
+                # wait_for_output; the error is re-raised on the CALLER's
+                # thread when its slot is claimed
+                out = _TaskError(e)
+            self._out.put(out)
 
     def has_output(self) -> bool:
         return self._submitted > 0
 
     def wait_for_output(self) -> O:
-        """Block for one result (Harp: waitForOutput)."""
+        """Block for one result (Harp: waitForOutput). Re-raises the task's
+        exception if the claimed slot failed."""
         self._submitted -= 1
-        return self._out.get()
+        out = self._out.get()
+        if isinstance(out, _TaskError):
+            raise out.error
+        return out
 
     def drain(self) -> List[O]:
         return [self.wait_for_output() for _ in range(self._submitted)]

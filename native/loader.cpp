@@ -221,4 +221,75 @@ int harp_parse_coo(const char* path, long long* rows, long long* cols,
   return 0;
 }
 
+// COO→CSR (HarpDAALDataSource.COOToCSR:439 parity): STABLE parallel
+// counting sort by row — O(nnz + num_rows) vs numpy's single-threaded
+// O(nnz log nnz) argsort. `indptr` needs num_rows+1 slots; `indices`/
+// `values_out` need nnz. Stability contract: entries of one row keep their
+// input order (duplicate (row, col) semantics depend on it upstream).
+// Returns 0 ok, 1 bad args, 4 row id out of [0, num_rows).
+int harp_coo_to_csr(const long long* rows, const long long* cols,
+                    const float* vals, long long nnz, long long num_rows,
+                    long long* indptr, long long* indices,
+                    float* values_out) {
+  if (nnz < 0 || num_rows < 0) return 1;
+  unsigned nt = pick_threads(static_cast<size_t>(nnz / 16 + 1));
+  // per-thread histograms cost nt*num_rows slots; keep the table ≤ 64M
+  // entries so wide-row inputs do not balloon host memory
+  while (nt > 1 &&
+         static_cast<long long>(nt) * num_rows > (64LL << 20)) nt--;
+  size_t per = static_cast<size_t>((nnz + nt - 1) / nt);
+  std::vector<std::vector<long long>> hist(
+      nt, std::vector<long long>(static_cast<size_t>(num_rows), 0));
+  std::vector<int> bad(nt, 0);
+  {
+    std::vector<std::thread> ts;
+    for (unsigned t = 0; t < nt; t++) {
+      size_t lo = t * per;
+      size_t hi = std::min(static_cast<size_t>(nnz), lo + per);
+      if (lo >= hi) break;
+      ts.emplace_back([&, t, lo, hi] {
+        auto& h = hist[t];
+        for (size_t i = lo; i < hi; i++) {
+          long long r = rows[i];
+          if (r < 0 || r >= num_rows) { bad[t] = 1; return; }
+          h[static_cast<size_t>(r)]++;
+        }
+      });
+    }
+    for (auto& th : ts) th.join();
+  }
+  for (int b : bad)
+    if (b) return 4;
+  // serial pass: global indptr + per-(row, thread) scatter bases. Thread
+  // chunks are consumed in input order, so base ordering = stability.
+  long long run = 0;
+  for (long long r = 0; r < num_rows; r++) {
+    indptr[r] = run;
+    for (unsigned t = 0; t < nt; t++) {
+      long long c = hist[t][static_cast<size_t>(r)];
+      hist[t][static_cast<size_t>(r)] = run;  // becomes this chunk's cursor
+      run += c;
+    }
+  }
+  indptr[num_rows] = run;
+  {
+    std::vector<std::thread> ts;
+    for (unsigned t = 0; t < nt; t++) {
+      size_t lo = t * per;
+      size_t hi = std::min(static_cast<size_t>(nnz), lo + per);
+      if (lo >= hi) break;
+      ts.emplace_back([&, t, lo, hi] {
+        auto& cursor = hist[t];
+        for (size_t i = lo; i < hi; i++) {
+          long long p = cursor[static_cast<size_t>(rows[i])]++;
+          indices[p] = cols[i];
+          values_out[p] = vals[i];
+        }
+      });
+    }
+    for (auto& th : ts) th.join();
+  }
+  return 0;
+}
+
 }  // extern "C"

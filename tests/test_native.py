@@ -82,3 +82,46 @@ def test_csr_roundtrip():
     assert indptr.tolist() == [0, 2, 3, 5]
     # row 0 entries: cols {0,1} vals {1,2}
     np.testing.assert_array_equal(np.sort(idx[0:2]), [0, 1])
+
+
+def test_native_coo_to_csr_matches_numpy_and_is_stable(native_lib):
+    from harp_tpu.io import native_bridge
+
+    rng = np.random.default_rng(4)
+    n, r = 50000, 700
+    rows = rng.integers(0, r, n)
+    cols = rng.integers(0, 900, n)
+    vals = rng.random(n).astype(np.float32)
+    out = native_bridge.coo_to_csr(rows, cols, vals, r)
+    assert out is not None
+    indptr, idx, v = out
+    order = np.argsort(rows, kind="stable")       # the stability oracle
+    ref_ptr = np.zeros(r + 1, np.int64)
+    np.add.at(ref_ptr, rows + 1, 1)
+    np.cumsum(ref_ptr, out=ref_ptr)
+    np.testing.assert_array_equal(indptr, ref_ptr)
+    np.testing.assert_array_equal(idx, cols[order])
+    np.testing.assert_array_equal(v, vals[order])
+
+
+def test_native_coo_to_csr_rejects_out_of_range(native_lib):
+    from harp_tpu.io import native_bridge
+
+    rows = np.array([0, 7], np.int64)
+    cols = np.array([0, 0], np.int64)
+    vals = np.ones(2, np.float32)
+    assert native_bridge.coo_to_csr(rows, cols, vals, 7) is None   # row == R
+    assert native_bridge.coo_to_csr(-rows, cols, vals, 7) is None  # negative
+
+
+def test_load_coo_multi_file_pool(native_lib, tmp_path):
+    """MTReader parity: files read by the thread pool, concatenated in path
+    order regardless of completion order."""
+    paths = []
+    for i in range(5):
+        lines = "\n".join(f"{i} {j} {i}.5" for j in range(4)) + "\n"
+        paths.append(_write(str(tmp_path), f"c{i}.coo", lines))
+    rows, cols, vals = loaders.load_coo(paths, num_threads=3)
+    assert rows.tolist() == sum(([i] * 4 for i in range(5)), [])
+    assert cols.tolist() == list(range(4)) * 5
+    np.testing.assert_allclose(vals, np.repeat(np.arange(5) + 0.5, 4))

@@ -163,3 +163,62 @@ class TestDatagen:
         r, c, v = datagen.sparse_ratings(50, 40, 8, density=0.2, seed=2)
         assert r.size == int(50 * 40 * 0.2)
         assert r.max() < 50 and c.max() < 40
+
+
+def test_scheduler_propagates_task_errors():
+    """A failing task must fail the CALLER, not hang drain() forever
+    (code-review r3: the monitor thread used to die without producing its
+    output slot)."""
+    from harp_tpu.sched.dynamic import DynamicScheduler, Task
+
+    class Boom(Task):
+        def run(self, item):
+            if item == 2:
+                raise RuntimeError("task 2 exploded")
+            return item * 10
+
+    sched = DynamicScheduler([Boom(), Boom()])
+    sched.start()
+    sched.submit_all([1, 2, 3])
+    with pytest.raises(RuntimeError, match="exploded"):
+        sched.drain()
+    sched.stop()
+
+
+def test_load_coo_missing_file_raises_not_hangs(tmp_path):
+    import os
+
+    from harp_tpu.io import loaders
+
+    good = os.path.join(str(tmp_path), "good.coo")
+    with open(good, "w") as f:
+        f.write("0 1 2.5\n")
+    with pytest.raises(Exception):
+        loaders.load_coo([good, os.path.join(str(tmp_path), "missing.coo")])
+
+
+def test_load_coo_duplicate_paths_keep_both(tmp_path):
+    import os
+
+    from harp_tpu.io import loaders
+
+    p = os.path.join(str(tmp_path), "a.coo")
+    with open(p, "w") as f:
+        f.write("0 1 2.0\n1 2 3.0\n")
+    rows, cols, vals = loaders.load_coo([p, p])
+    assert rows.tolist() == [0, 1, 0, 1]
+    assert vals.tolist() == [2.0, 3.0, 2.0, 3.0]
+
+
+def test_coo_to_csr_validates_and_fixes_dtype():
+    from harp_tpu.io import loaders
+
+    rows = np.array([0, -1], np.int64)
+    with pytest.raises(ValueError, match="row ids"):
+        loaders.coo_to_csr(rows, np.zeros(2, np.int64),
+                           np.ones(2, np.float64), num_rows=2)
+    # f64 values come back f32 on BOTH paths (build-independent dtype)
+    ip, ix, v = loaders.coo_to_csr(np.array([1, 0]), np.array([3, 4]),
+                                   np.array([1.5, 2.5], np.float64))
+    assert v.dtype == np.float32
+    assert ip.tolist() == [0, 1, 2] and ix.tolist() == [4, 3]
