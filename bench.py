@@ -176,7 +176,7 @@ def tpu_als_iters_per_sec(nu, ni, iters):
         dt = time.perf_counter() - t0
         best = max(best, iters / dt)
         rmse_last = float(rmse[-1])
-    return best, rmse_last
+    return best, rmse_last, model.last_layout_stats.get("layout", "sparse")
 
 
 def cpu_als_iters_per_sec(nu, ni, iters):
@@ -420,7 +420,8 @@ def main():
         nu, nu, epochs=sgd_epochs, rank=128)
 
     an = 2048 if small else 8192
-    als_ips, als_rmse = tpu_als_iters_per_sec(an, an, iters=3 if small else 10)
+    als_ips, als_rmse, als_layout = tpu_als_iters_per_sec(
+        an, an, iters=3 if small else 10)
     als_cpu = cpu_als_iters_per_sec(an, an, iters=1)
 
     pn, pd = (32768, 64) if small else (262144, 256)
@@ -461,6 +462,7 @@ def main():
         "als_iters_per_sec": round(als_ips, 3),
         "als_vs_cpu": round(als_ips / als_cpu, 2),
         "als_final_rmse": round(als_rmse, 4),
+        "als_layout": als_layout,
         "pca_fits_per_sec": round(pca_fps, 3),
         "pca_vs_cpu": round(pca_fps / pca_cpu, 2),
         "pca_top_eigenvalue": round(pca_top, 5),

@@ -38,7 +38,8 @@ def test_als_zipf_at_scale(session):
     rows, cols, vals = datagen.zipf_ratings(
         num_users=4096, num_items=4096, rank=8, alpha=1.2, density=0.01,
         seed=2, noise=0.01)
-    cfg = als.ALSConfig(rank=16, lam=0.05, iterations=3, implicit=False)
+    cfg = als.ALSConfig(rank=16, lam=0.05, iterations=3, implicit=False,
+                        layout="sparse")     # this test is ABOUT the chunks
     model = als.ALS(session, cfg)
     u, v, rmse = model.fit(rows, cols, vals, 4096, 4096)
     assert model.last_layout_stats["overhead"] <= 4.0
