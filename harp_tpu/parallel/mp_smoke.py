@@ -148,6 +148,29 @@ def run(process_id: int, num_processes: int, port: int,
         # barrier before close so no send races a closed server
         multihost_utils.sync_global_devices("p2p-smoke-done")
 
+    # --- session-level event API (CollectiveMapper getEvent/waitEvent/
+    # sendEvent parity): collective fan-out + transport-backed P2P. One
+    # shared queue, and P2P delivery is ASYNCHRONOUS — the predecessor's
+    # message may land before our own collective enqueue, so consume
+    # order-agnostically (the reference's EventQueue made the same
+    # non-promise about arrival order) ------------------------------------ #
+    sess.send_event({"note": "gang-wide"}, source=0)
+    sess.send_event("session-p2p", dest=(process_id + 1) % num_processes)
+    got = []
+    for _ in range(2):
+        ev = sess.wait_event(timeout=60.0)
+        assert ev is not None, got
+        got.append(ev)
+    assert {e.type for e in got} == {EventType.COLLECTIVE,
+                                     EventType.MESSAGE}, got
+    coll = next(e for e in got if e.type is EventType.COLLECTIVE)
+    msg = next(e for e in got if e.type is EventType.MESSAGE)
+    assert coll.payload["note"] == "gang-wide"
+    assert msg.payload == "session-p2p"
+    assert msg.source == (process_id - 1) % num_processes
+    multihost_utils.sync_global_devices("session-events-done")
+    sess.close_events()
+
     # --- barrier + teardown --------------------------------------------------- #
     sess.barrier()          # multihost branch: sync_global_devices
     distributed.shutdown()

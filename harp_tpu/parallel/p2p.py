@@ -19,8 +19,9 @@ and scales to frequent events on large gangs.
 Addressing: pass an explicit ``{rank: (host, port)}`` map, or let members
 rendezvous through the jax.distributed coordinator's key-value store (the
 same service that replaced Harp's HDFS ``<jobID>/nodes`` files): each member
-publishes ``harp/p2p/<rank> = host:port`` and peers resolve lazily on first
-send.
+publishes ``harp/p2p/<namespace>/<rank> = host:port`` and peers resolve
+lazily on first send (KV keys are write-once, so each transport generation
+needs its own ``kv_namespace``, agreed across the gang).
 
 Wire format: 8-byte big-endian length + pickle of ``(source, payload)``.
 Pickle over gang sockets matches the reference's trust model (it moved
@@ -106,10 +107,14 @@ class P2PTransport:
                  peers: Optional[Dict[int, Tuple[str, int]]] = None,
                  host: str = "0.0.0.0", port: int = 0,
                  advertise_host: Optional[str] = None,
+                 kv_namespace: str = "default",
                  retries: int = 3, retry_sleep_s: float = 0.1,
                  connect_timeout_s: float = 30.0):
         self.queue = event_queue
         self.rank = rank
+        # coordinator KV keys are write-once: each transport generation needs
+        # its own namespace (all gang members must pass the same one)
+        self._kv_prefix = f"{_KV_PREFIX}{kv_namespace}/"
         self._explicit_peers = peers is not None
         self._peers: Dict[int, Tuple[str, int]] = dict(peers or {})
         self._conns: Dict[int, socket.socket] = {}
@@ -138,7 +143,7 @@ class P2PTransport:
         if not self._explicit_peers:
             client = _kv_client()
             if client is not None:
-                client.key_value_set(f"{_KV_PREFIX}{self.rank}",
+                client.key_value_set(f"{self._kv_prefix}{self.rank}",
                                      f"{self.address[0]}:{self.address[1]}")
 
     # ------------------------------------------------------------------ #
@@ -203,7 +208,7 @@ class P2PTransport:
                 f"worker {dest} unknown and no jax.distributed gang is "
                 f"initialized to rendezvous through")
         val = client.blocking_key_value_get(
-            f"{_KV_PREFIX}{dest}", int(self._connect_timeout_s * 1000))
+            f"{self._kv_prefix}{dest}", int(self._connect_timeout_s * 1000))
         host, port_s = val.rsplit(":", 1)
         addr = (host, int(port_s))
         with self._lock:

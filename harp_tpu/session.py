@@ -131,3 +131,83 @@ class HarpSession:
             multihost_utils.sync_global_devices(f"{self.name}-barrier")
         else:
             (jax.device_put(np.zeros(()))).block_until_ready()
+
+    # -- events (Harp: CollectiveMapper.getEvent:623/waitEvent:632/sendEvent:645)
+    # Addressed by PROCESS rank (jax.process_index) — the host control plane —
+    # not by device-level worker id.
+    _event_gen = 0      # class-wide generation counter; SPMD processes run
+    #                     identical code, so generations align across the gang
+
+    def open_events(self):
+        """Bring up the event plane (idempotent): the queue, client, and —
+        multi-process — the P2P transport server. RECEIVERS that only poll
+        with :meth:`get_event` must call this (or :meth:`wait_event`) so
+        their server exists before a peer resolves it. Each open after a
+        :meth:`close_events` is a new generation with a fresh KV-rendezvous
+        namespace (coordinator KV keys are write-once)."""
+        if not hasattr(self, "_events"):
+            from harp_tpu.parallel import events as ev
+
+            queue = ev.EventQueue()
+            transport = None
+            if jax.process_count() > 1:
+                # true P2P between gang members (parallel/p2p.py; KV-store
+                # rendezvous through the same coordinator the gang joined)
+                from harp_tpu.parallel.p2p import P2PTransport
+
+                gen = HarpSession._event_gen
+                HarpSession._event_gen += 1
+                transport = P2PTransport(
+                    queue, rank=jax.process_index(),
+                    kv_namespace=f"{self.name}-session-g{gen}")
+            self._events = (queue, ev.EventClient(
+                queue, worker_id=jax.process_index(), transport=transport),
+                transport)
+        return self._events
+
+    def get_event(self):
+        """Non-blocking event poll (CollectiveMapper.getEvent:623). Returns
+        None when the plane has not been opened — a pure peek never spins
+        up the transport server."""
+        if not hasattr(self, "_events"):
+            return None
+        return self._events[0].get()
+
+    def wait_event(self, timeout: Optional[float] = None):
+        """Blocking event wait (CollectiveMapper.waitEvent:632); opens the
+        event plane (receiving intent — the transport server must be up)."""
+        return self.open_events()[0].wait(timeout)
+
+    def send_event(self, payload, dest: Optional[int] = None,
+                   source: Optional[int] = None) -> None:
+        """CollectiveMapper.sendEvent:645: ``dest=None`` delivers to every
+        process (COLLECTIVE — all processes must call, same ``source``);
+        a concrete ``dest`` is a point-to-point MESSAGE to that PROCESS
+        rank (sender-only call when the gang transport is up; see
+        events.EventClient.send_message for the transportless fallback's
+        call pattern).
+
+        Ordering: all events share ONE queue and transport MESSAGEs are
+        delivered asynchronously, so a peer's message may be dequeued
+        before an event this process enqueued first — match on
+        ``Event.type``/``source``, don't assume arrival order (the
+        reference's EventQueue gave the same non-guarantee)."""
+        if dest is not None and not (0 <= dest < jax.process_count()):
+            raise ValueError(
+                f"dest must be a process rank in [0, {jax.process_count()}) "
+                f"— events are the host control plane, addressed per "
+                f"PROCESS, not per device-level worker; got {dest}")
+        client = self.open_events()[1]
+        if dest is None:
+            client.send_collective(payload, source=source)
+        else:
+            client.send_message(dest, payload, source=source)
+
+    def close_events(self) -> None:
+        """Tear down the event plane (CollectiveMapper teardown :783-788).
+        A later open_events/send_event/wait_event starts a new generation."""
+        if hasattr(self, "_events"):
+            transport = self._events[2]
+            if transport is not None:
+                transport.close()
+            del self._events

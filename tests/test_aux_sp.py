@@ -184,3 +184,24 @@ def test_ulysses_attention_matches_reference(session):
             jnp.asarray(q[:, i]), jnp.asarray(k[:, i]), jnp.asarray(v[:, i]),
             True)) for i in range(h)], axis=1)
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-3, atol=2e-3)
+
+
+def test_session_event_api_single_process(session):
+    """CollectiveMapper getEvent/waitEvent/sendEvent parity on HarpSession
+    (single-process: local delivery, no transport)."""
+    from harp_tpu.parallel.events import EventType
+
+    assert session.get_event() is None
+    session.send_event({"k": 1})                 # collective → local queue
+    ev = session.get_event()
+    assert ev is not None and ev.type is EventType.COLLECTIVE
+    assert ev.payload == {"k": 1}
+    session.send_event("mine", dest=0)           # dest == self
+    ev = session.wait_event(timeout=5.0)
+    assert ev is not None and ev.payload == "mine"
+    import pytest as _pt
+
+    with _pt.raises(ValueError, match="process rank"):
+        session.send_event("not-mine", dest=3)   # rank out of range: loud
+    session.close_events()
+    assert session.get_event() is None           # closed plane: pure peek

@@ -47,3 +47,13 @@ def test_nodes_file_launcher_runs_the_gang(tmp_path):
     finally:
         os.chdir(old)
     assert rc == 0
+
+
+def test_three_process_gang():
+    """A wider gang (3 procs × 2 devices): the ring schedules, KV rendezvous
+    namespaces, and session event plane must not be 2-process artifacts.
+    Generous timeout: three members compile concurrently on ONE shared host
+    core, so member skew is minutes, not seconds."""
+    outs = mp_smoke.spawn_gang(num_processes=3, devices_per_process=2,
+                               timeout=600.0, repo_root=REPO)
+    assert len(outs) == 3
