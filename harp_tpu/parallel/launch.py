@@ -71,9 +71,10 @@ def gang_env(nodes: Sequence[Node], process_id: int, port: int) -> dict:
     }
 
 
-def _spawn(node: Node, env: dict, command: List[str]) -> subprocess.Popen:
+def _spawn(node: Node, env: dict, command: List[str],
+           cwd: Optional[str] = None) -> subprocess.Popen:
     if node.host in LOCAL_HOSTS:
-        return subprocess.Popen(command, env={**os.environ, **env},
+        return subprocess.Popen(command, env={**os.environ, **env}, cwd=cwd,
                                 stdout=subprocess.PIPE,
                                 stderr=subprocess.STDOUT, text=True)
     # remote: same role as Depl.executeCMDandReturn:54 — env rides the ssh
@@ -81,7 +82,7 @@ def _spawn(node: Node, env: dict, command: List[str]) -> subprocess.Popen:
     # a pty so that killing the local ssh client HUPs the remote session:
     # fail-stop reaches the remote member, not just its local proxy.
     exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
-    remote = (f"cd {shlex.quote(os.getcwd())} && {exports} "
+    remote = (f"cd {shlex.quote(cwd or os.getcwd())} && {exports} "
               + " ".join(shlex.quote(tok) for tok in command))
     return subprocess.Popen(["ssh", "-tt", "-o", "BatchMode=yes", node.host,
                              remote],
@@ -100,8 +101,11 @@ def _drain(proc: subprocess.Popen, sink: List[str]) -> None:
 
 def launch(nodes: Sequence[Node], command: List[str], port: int = 0,
            timeout: Optional[float] = 1800.0,
-           poll_interval: float = 0.05) -> List[Tuple[int, str]]:
+           poll_interval: float = 0.05,
+           cwd: Optional[str] = None) -> List[Tuple[int, str]]:
     """Launch ``command`` once per node with the gang env; wait for all.
+    ``cwd`` sets every member's working directory (local Popen cwd, remote
+    ``cd``); default = this process's.
 
     Returns [(returncode, combined output)] in node order. Fail-stop: all
     members are polled concurrently (stdout drained by threads), and the
@@ -116,7 +120,7 @@ def launch(nodes: Sequence[Node], command: List[str], port: int = 0,
         with socket.socket() as s:
             s.bind(("", 0))
             port = s.getsockname()[1]
-    procs = [_spawn(node, gang_env(nodes, i, port), command)
+    procs = [_spawn(node, gang_env(nodes, i, port), command, cwd=cwd)
              for i, node in enumerate(nodes)]
     sinks: List[List[str]] = [[] for _ in procs]
     drains = [threading.Thread(target=_drain, args=(p, s), daemon=True)

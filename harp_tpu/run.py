@@ -49,9 +49,25 @@ def _session(args):
 
     if args.cpu_mesh:
         jax.config.update("jax_platforms", "cpu")
+    # join the gang when launched by parallel.launch (HARP_COORDINATOR in
+    # the environment — the reference's launchers always ran under the
+    # gang), so
+    #   python -m harp_tpu.parallel.launch nodes -- python -m harp_tpu.run …
+    # trains ONE distributed model across the gang's global mesh instead of
+    # N independent copies. Gated on the LAUNCHER env specifically: the
+    # broader TPU-pod auto-detect (TPU_WORKER_HOSTNAMES) misfires on
+    # single-chip tunnel hosts that export pod-shaped variables
+    if os.environ.get("HARP_COORDINATOR"):
+        from harp_tpu.parallel import distributed
+
+        distributed.initialize()
     from harp_tpu.session import HarpSession
 
     n = args.num_workers or len(jax.devices())
+    if jax.process_count() > 1:
+        # gang mode: --num-workers sized this member's VIRTUAL device share
+        # (the cpu-mesh flag above); the session always spans the global mesh
+        n = len(jax.devices())
     return HarpSession(num_workers=min(n, len(jax.devices())))
 
 
@@ -135,10 +151,13 @@ def run_kmeans(argv) -> int:
     else:
         print(f"kmeans[{cfg.comm}] workers={sess.num_workers}: fully "
               f"resumed from checkpoint, nothing left to run")
-    if args.work_dir:
+    import jax
+
+    if args.work_dir and jax.process_index() == 0:
         os.makedirs(args.work_dir, exist_ok=True)
-        # reference: KMUtil.storeCentroids writes the final model (also on a
-        # fully-resumed run — the restored centroids ARE the model)
+        # reference: KMUtil.storeCentroids writes the final model from the
+        # MASTER (also on a fully-resumed run — the restored centroids ARE
+        # the model); gang members skip the write
         np.savetxt(os.path.join(args.work_dir, "centroids.csv"),
                    np.asarray(cen), delimiter=",")
     return 0

@@ -45,7 +45,13 @@ class Checkpointer:
                  async_save: bool = False):
         self.directory = os.path.abspath(directory)
         self.keep = keep
-        self.use_orbax = use_orbax and _HAVE_ORBAX
+        # gang mode uses the self-contained numpy format: orbax's save runs
+        # its own multihost coordination that expects EVERY process to call
+        # it, while the gang contract here is master-only writes of
+        # replicated state (save() docstring) — an orbax master-only save
+        # deadlocks in that internal sync
+        self.use_orbax = (use_orbax and _HAVE_ORBAX
+                          and jax.process_count() == 1)
         os.makedirs(self.directory, exist_ok=True)
         if self.use_orbax:
             self._ckptr = _ocp.PyTreeCheckpointer()
@@ -78,8 +84,18 @@ class Checkpointer:
         """Save a pytree of arrays; prunes to the newest ``keep`` checkpoints.
 
         With ``async_save`` the device→host snapshot happens here (consistent
-        cut) and the disk write runs on the background thread."""
+        cut) and the disk write runs on the background thread.
+
+        Multi-process gangs: every member calls save at the same logical
+        step with IDENTICAL (replicated) state, and only the MASTER writes —
+        concurrent writers on a shared work dir would tear step directories
+        (the reference's storeCentroids likewise wrote from the master). The
+        in-loop collectives keep members from racing past the chunk
+        boundary while the master writes. Gang resume assumes the work dir
+        is SHARED across members (the reference's HDFS assumption)."""
         path = self._step_dir(step)
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            return path
         state = jax.tree.map(np.asarray, state)    # D2H snapshot
         if self._executor is not None:
             self.wait()                            # one write in flight

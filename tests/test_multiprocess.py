@@ -57,3 +57,35 @@ def test_three_process_gang():
     outs = mp_smoke.spawn_gang(num_processes=3, devices_per_process=2,
                                timeout=600.0, repo_root=REPO)
     assert len(outs) == 3
+
+
+def test_gang_launcher_runs_cli_training(tmp_path):
+    """End-to-end depl parity: the nodes-file launcher runs a REAL training
+    command, the run.py subcommand joins the gang (distributed.initialize
+    reads the launcher's HARP_* env), and ONE distributed K-means trains
+    over the gang's global mesh — not N independent copies."""
+    import sys
+
+    from harp_tpu.parallel import launch
+
+    work = tmp_path / "km"
+    cmd = [sys.executable, "-m", "harp_tpu.run", "kmeans", "--cpu-mesh",
+           "--num-workers", "2", "--num-points", "512", "--num-centroids",
+           "4", "--dim", "8", "--iterations", "4", "--work-dir", str(work),
+           "--save-every", "2"]
+    nodes = [launch.Node("localhost", 0) for _ in range(2)]
+    results = launch.launch(nodes, cmd, timeout=420.0, cwd=REPO)
+    for rc, out in results:
+        assert rc == 0, out[-2000:]
+        # the session spans the gang: 2 members x 2 virtual devices
+        assert "workers=4" in out, out[-500:]
+    # master (process 0) wrote the model and the checkpoints ONCE (gang
+    # members skip writes — the shared-work-dir contract)
+    assert (work / "centroids.csv").exists()
+    assert (work / "ckpt").is_dir()
+    # second launch: the checkpoint already covers every iteration — every
+    # member resumes cleanly instead of re-training or tearing the dir
+    results = launch.launch(nodes, cmd, timeout=420.0, cwd=REPO)
+    for rc, out in results:
+        assert rc == 0, out[-2000:]
+        assert "fully resumed" in out, out[-500:]
