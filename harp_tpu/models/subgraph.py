@@ -42,6 +42,33 @@ from harp_tpu.parallel.mesh import WORKERS
 from harp_tpu.session import HarpSession
 
 MAX_TEMPLATE = 7    # 2^k DP columns; 128 keeps the tables lane-aligned
+#                     (exceeds the reference's shipped templates, which top
+#                     out at u5-2 — datasets/daal_subgraph/templates/)
+
+
+def load_template_file(path: str) -> List[Tuple[int, int]]:
+    """Parse the reference's ``.template`` format
+    (datasets/daal_subgraph/templates/u5-2.template): first line = vertex
+    count, second = edge count, then one ``a b`` edge per line. Returns the
+    edge list for :class:`TreeTemplate` / ``count_template``."""
+    with open(path) as f:
+        tokens = f.read().split()
+    if len(tokens) < 2:
+        raise ValueError(f"template file {path} is empty")
+    n_vertices, n_edges = int(tokens[0]), int(tokens[1])
+    flat = tokens[2:]
+    if len(flat) != 2 * n_edges:
+        raise ValueError(
+            f"template file {path} declares {n_edges} edges but carries "
+            f"{len(flat) // 2}")
+    edges = [(int(flat[2 * i]), int(flat[2 * i + 1]))
+             for i in range(n_edges)]
+    seen = {v for e in edges for v in e}
+    if seen and (min(seen) < 0 or max(seen) >= n_vertices):
+        raise ValueError(
+            f"template file {path} has vertex ids outside "
+            f"[0, {n_vertices})")
+    return edges
 
 
 # --------------------------------------------------------------------------- #

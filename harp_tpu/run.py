@@ -488,6 +488,10 @@ def run_subgraph(argv) -> int:
     p.add_argument("--template", default="",
                    help="tree edges like '0-1,1-2,1-3' (default: a path of "
                         "--template-size vertices)")
+    p.add_argument("--template-file", default="",
+                   help="a reference-format .template file (vertex count, "
+                        "edge count, then one edge per line — the "
+                        "datasets/daal_subgraph/templates format)")
     _add_config_flags(p, SubgraphConfig)
     args = p.parse_args(argv)
     sess = _session(args)
@@ -501,7 +505,13 @@ def run_subgraph(argv) -> int:
     dst = rng.integers(0, args.num_vertices, args.num_edges)
     counter = subgraph.SubgraphCounter(sess, cfg)
     t0 = time.perf_counter()
-    if args.template:
+    if args.template_file:
+        edges = subgraph.load_template_file(args.template_file)
+        est, trials = counter.count_template(edges, src, dst,
+                                             args.num_vertices,
+                                             seed=args.seed)
+        shape = os.path.basename(args.template_file)
+    elif args.template:
         edges = [tuple(map(int, e.split("-"))) for e in
                  args.template.split(",")]
         est, trials = counter.count_template(edges, src, dst,

@@ -228,3 +228,26 @@ def test_general_tree_dp_reproduces_path_counts(session):
     est, _ = subgraph.SubgraphCounter(session, cfg).count_paths(
         src, dst, n, seed=9)
     assert abs(est - exact) < 0.3 * exact + 2.0
+
+
+def test_template_file_format_roundtrip(tmp_path, session):
+    """The reference's .template format (u5-2: vertex count, edge count,
+    edges) parses and counts — datasets/daal_subgraph/templates parity."""
+    from harp_tpu.models import subgraph
+
+    p = tmp_path / "u5-2.template"
+    p.write_text("5\n4\n0 1\n0 2\n0 3\n3 4\n")
+    edges = subgraph.load_template_file(str(p))
+    assert edges == [(0, 1), (0, 2), (0, 3), (3, 4)]
+    t = subgraph.TreeTemplate(edges)
+    assert t.k == 5
+    bad = tmp_path / "bad.template"
+    bad.write_text("3\n2\n0 1\n")          # declares 2 edges, carries 1
+    import pytest
+
+    with pytest.raises(ValueError, match="declares"):
+        subgraph.load_template_file(str(bad))
+    oob = tmp_path / "oob.template"
+    oob.write_text("3\n2\n0 1\n1 5\n")     # vertex 5 outside [0, 3)
+    with pytest.raises(ValueError, match="outside"):
+        subgraph.load_template_file(str(oob))
