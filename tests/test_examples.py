@@ -141,3 +141,13 @@ def test_run_family_cli(args, expect):
     out = _run_cmd(args)
     assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
     assert expect in out.stdout, out.stdout
+
+
+def test_gang_tour_example():
+    """The round-3 distributed-runtime tour: gang launch -> distributed CLI
+    training with checkpoints -> full resume -> fail-stop."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "gang_tour.py")],
+        env=ENV, capture_output=True, text=True, timeout=700)
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
+    assert "gang tour OK" in out.stdout
