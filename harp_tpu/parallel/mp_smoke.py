@@ -170,6 +170,14 @@ def run(process_id: int, num_processes: int, port: int,
     assert msg.source == (process_id - 1) % num_processes
     multihost_utils.sync_global_devices("session-events-done")
     sess.close_events()
+    # second generation: reopening after close must rendezvous under a FRESH
+    # KV namespace (coordinator keys are write-once — a fixed namespace
+    # would crash here or resolve the closed port)
+    sess.send_event("gen2", dest=(process_id + 1) % num_processes)
+    ev = sess.wait_event(timeout=60.0)
+    assert ev is not None and ev.payload == "gen2", ev
+    multihost_utils.sync_global_devices("session-events-gen2-done")
+    sess.close_events()
 
     # --- barrier + teardown --------------------------------------------------- #
     sess.barrier()          # multihost branch: sync_global_devices
