@@ -56,17 +56,20 @@ class ALSConfig:
     implicit: bool = True
     balance: bool = True        # serpentine-LPT row→worker assignment
     chunk_factor: float = 2.0   # chunk cap = ceil(chunk_factor * mean entries)
-    solver: str = "auto"        # auto | cholesky | newton — how the batched
-    #   k×k SPD normal equations are solved. The solve DOMINATES ALS on TPU
-    #   (measured ablation, PERF.md r3: the bench iteration is 70 ms with
-    #   the solve and 9.6 ms without): batched 32×32 operands underfill the
-    #   128-lane MXU, so every algorithm plateaus near ~0.7 TFLOP/s —
-    #   Cholesky ≈ Newton–Schulz inverse iteration ≈ 30 ms per (8192, 32,
-    #   32)-batch solve pair, and 4×-block-diagonal packing is 5× WORSE
-    #   (triangular-solve cost scales with the serial k). "auto" = cholesky
-    #   (exact, and as fast as anything measured); "newton" (pure batched
-    #   GEMMs, Precision.HIGHEST — TPU's default bf16 multiply floors its
-    #   quadratic convergence at ~1e-1) is kept as the measured alternative.
+    solver: str = "auto"        # auto | pallas | cholesky | newton — how the
+    #   batched k×k SPD normal equations are solved. The solve DOMINATED ALS
+    #   on TPU through r3 (measured ablation, PERF.md: the bench iteration
+    #   was 70 ms with the solve and 9.6 ms without): XLA's batched-solve
+    #   lowering serializes on k and underfills the MXU, so Cholesky ≈
+    #   Newton–Schulz ≈ 30 ms per (8192, 32, 32)-batch solve pair despite
+    #   the solve being only ~180 MFLOP. "pallas" is the r4 fix — a
+    #   lane-vectorized batched Cholesky (ops/pallas_kernels.spd_solve_pallas:
+    #   batch on the 128-lane axis, unrolled outer-product factorization +
+    #   substitutions, pure full-width VPU work) that makes the solve
+    #   HBM-bound. "auto" = pallas on TPU at k ≤ 64, else cholesky (exact
+    #   XLA path); "newton" (pure batched GEMMs, Precision.HIGHEST — TPU's
+    #   default bf16 multiply floors its quadratic convergence at ~1e-1) is
+    #   kept as the measured alternative.
     newton_iters: int = 30
     layout: str = "auto"        # auto | dense | sparse — "dense" stores the
     #   rating matrix as NaN-encoded bf16 planes and computes each side's
@@ -158,14 +161,17 @@ def pad_csr_chunks(rows, cols, vals, num_rows, num_workers,
 
 
 def _resolve_solver(cfg: ALSConfig) -> str:
-    if cfg.solver not in ("auto", "cholesky", "newton"):
-        raise ValueError(f"solver must be auto|cholesky|newton, got "
+    if cfg.solver not in ("auto", "pallas", "cholesky", "newton"):
+        raise ValueError(f"solver must be auto|pallas|cholesky|newton, got "
                          f"{cfg.solver!r}")
     if cfg.solver != "auto":
         return cfg.solver
-    # measured on v5e (PERF.md r3): cholesky ties or beats newton at every
-    # batch shape tried, and is exact — it wins everywhere
-    return "cholesky"
+    from harp_tpu.ops.pallas_kernels import use_spd_solve_pallas
+
+    # measured on v5e (PERF.md r4): the lane-vectorized pallas Cholesky
+    # breaks the XLA batched-solve plateau; where it doesn't apply,
+    # cholesky ties or beats newton at every batch shape tried and is exact
+    return "pallas" if use_spd_solve_pallas(cfg.rank) else "cholesky"
 
 
 def _spd_solve(a, b, cfg: ALSConfig):
@@ -180,7 +186,20 @@ def _spd_solve(a, b, cfg: ALSConfig):
     MXU for both, ~30 ms per solve pair either way (ALSConfig.solver note,
     PERF.md r3). Kept as the measured alternative and for platforms where
     batched triangular solves lower worse."""
-    if _resolve_solver(cfg) == "cholesky":
+    solver = _resolve_solver(cfg)
+    if solver == "pallas":
+        from harp_tpu.ops import pallas_kernels
+
+        if not pallas_kernels._HAVE_PALLAS:
+            raise ValueError(
+                "solver='pallas' requires jax.experimental.pallas; use "
+                "solver='cholesky' (or 'auto') on this platform")
+        # explicit request off-TPU runs the kernel in interpret mode (slow
+        # but exact — the path CI and the CPU mesh exercise); 'auto' never
+        # resolves here off-TPU
+        interpret = jax.default_backend() != "tpu"
+        return pallas_kernels.spd_solve_pallas(a, b, interpret=interpret)
+    if solver == "cholesky":
         return jax.scipy.linalg.solve(a, b[..., None], assume_a="pos")[..., 0]
     k = a.shape[-1]
     eye = jnp.eye(k, dtype=a.dtype)

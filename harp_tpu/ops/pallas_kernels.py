@@ -257,6 +257,125 @@ def dense_mf_hop_pallas(vb: jax.Array, w_t: jax.Array, h_t: jax.Array,
     return w_t_new, h_t_new, jnp.sum(sse128)
 
 
+# --------------------------------------------------------------------------- #
+# Batched small-SPD Cholesky solve (the ALS normal-equations bottleneck)
+# --------------------------------------------------------------------------- #
+#
+# XLA lowers batched (N, K, K) `solve(..., assume_a="pos")` through a
+# triangular-solve path that serializes on K and underfills the MXU: measured
+# 30 ms per (8192, 32, 32) solve pair on v5e — yet the solve is only ~180
+# MFLOP, i.e. the lowering runs at ~0.006 TFLOP/s. The fix is a LAYOUT move,
+# not a FLOP move: put the BATCH on the 128-lane axis ((K, K, B) tiles,
+# matrices ride sublanes/leading dim) so every step of an unrolled
+# outer-product Cholesky + the two substitutions is a full-width VPU
+# elementwise op across B independent systems. No MXU involvement at all —
+# the MXU was never the right unit for K≤64 systems; the VPU at full lane
+# occupancy is. HBM traffic is one read of A (the only O(N·K²) term), so the
+# kernel is bandwidth-bound at ~40 µs for the bench shape.
+#
+# Reference role: DAAL's cblas/LAPACK POTRF+POTRS behind
+# daal_als/ALSDaalCollectiveMapper.java:49's train steps.
+
+
+def _chol_solve_kernel(a_ref, b_ref, x_ref, *, k: int):
+    """One batch tile: A (k, k, B) SPD, b (k, B) → x (k, B).
+
+    Unrolled outer-product Cholesky: at step j, column j of the running
+    Schur complement IS column j of L (after scaling); the rank-1 update
+    A ← A − l_j l_jᵀ touches only unfinished rows/cols because l_j is
+    masked to zero above the diagonal. Forward/backward substitution reuse
+    the same columns; every op is (k, B) or (k, k, B) elementwise."""
+    a = a_ref[...].astype(jnp.float32)            # (k, k, B)
+    b = b_ref[...].astype(jnp.float32)            # (k, B)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (k, 1), 0)  # (k, 1) row index
+
+    cols = []
+    for j in range(k):
+        col = a[:, j, :]                          # (k, B) Schur column j
+        dinv = jax.lax.rsqrt(col[j:j + 1, :])     # (1, B); SPD ⇒ diag > 0
+        lj = jnp.where(rows >= j, col * dinv, 0.0)
+        cols.append(lj)
+        if j + 1 < k:
+            a = a - lj[:, None, :] * lj[None, :, :]
+
+    # forward substitution  L y = b  (l_j[j] is the diag entry sqrt(d))
+    r = b
+    ys = []
+    for j in range(k):
+        yj = r[j:j + 1, :] / cols[j][j:j + 1, :]  # (1, B)
+        ys.append(yj)
+        if j + 1 < k:
+            r = r - cols[j] * yj
+    y = jnp.concatenate(ys, axis=0)               # (k, B)
+
+    # backward substitution  Lᵀ x = y: equation i is Σ_p L[p, i] x_p, so when
+    # x_p lands, subtract ROW p of L (over column index i) from the residual
+    lfull = jnp.stack(cols, axis=1)               # (k_row, k_col, B)
+    r = y
+    xs = [None] * k
+    for p in range(k - 1, -1, -1):
+        xp = r[p:p + 1, :] / cols[p][p:p + 1, :]
+        xs[p] = xp
+        if p:
+            r = r - lfull[p, :, :] * xp
+    x_ref[...] = jnp.concatenate(xs, axis=0)
+
+
+def spd_solve_pallas(a: jax.Array, b: jax.Array, tile_b: int = 256,
+                     interpret: bool = False) -> jax.Array:
+    """Solve batched SPD systems ``a @ x = b``: a (N, K, K), b (N, K) → (N, K).
+
+    Pads K up to a sublane multiple (identity diagonal, zero rhs — padded
+    components solve to 0 and never couple) and N up to a lane-tile multiple
+    (identity systems). The (N, K, K) → (K, K, N) transpose that puts the
+    batch on lanes is one HBM-bound XLA pass, ~µs at ALS shapes."""
+    n, k = b.shape
+    if a.shape != (n, k, k):
+        raise ValueError(f"spd_solve_pallas: a {a.shape} vs b {b.shape}")
+    kp = max(8, -(-k // 8) * 8)
+    npad = -(-n // tile_b) * tile_b
+    if kp != k:
+        a = jnp.pad(a, ((0, 0), (0, kp - k), (0, kp - k)))
+        a = a + jnp.pad(jnp.zeros((k,), a.dtype), (0, kp - k),
+                        constant_values=1.0) * jnp.eye(kp, dtype=a.dtype)[None]
+        b = jnp.pad(b, ((0, 0), (0, kp - k)))
+    if npad != n:
+        eye_tail = jnp.broadcast_to(jnp.eye(kp, dtype=a.dtype),
+                                    (npad - n, kp, kp))
+        a = jnp.concatenate([a, eye_tail], axis=0)
+        b = jnp.pad(b, ((0, npad - n), (0, 0)))
+    at = jnp.transpose(a, (1, 2, 0)).astype(jnp.float32)  # (K, K, N)
+    bt = jnp.transpose(b, (1, 0)).astype(jnp.float32)     # (K, N)
+    grid = npad // tile_b
+    kernel = functools.partial(_chol_solve_kernel, k=kp)
+    xt = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((kp, kp, tile_b), lambda i: (0, 0, i)),
+            pl.BlockSpec((kp, tile_b), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((kp, tile_b), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((kp, npad), jnp.float32),
+        interpret=interpret,
+    )(at, bt)
+    return jnp.transpose(xt, (1, 0))[:n, :k]
+
+
+def use_spd_solve_pallas(k: int) -> bool:
+    """Dispatch predicate: default ON for TPU at the small ranks where the
+    XLA batched-solve lowering craters (K ≤ 64 unrolls to a modest op count
+    and the (K, K, B) working set stays in VMEM); opt out with
+    HARP_ALS_PALLAS=0."""
+    import os
+
+    if os.environ.get("HARP_ALS_PALLAS", "1") == "0" or not _HAVE_PALLAS:
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    return k <= 64
+
+
 def use_dense_mf_pallas(cpb: int, s_rows: int, k: int) -> bool:
     """Dispatch predicate for the fused dense-MF hop: default ON for TPU
     (measured multi-x win over the XLA lowering — module doc), opt out with
