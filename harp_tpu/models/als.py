@@ -76,8 +76,14 @@ class ALSConfig:
     #   normal equations as two big GEMMs (conf @ VV and weighted @ V): the
     #   sparse path's factor-row gathers are 128 B granules (~25M rows/s,
     #   the same wall dense SGD-MF hit), while the dense A-GEMM runs the
-    #   MXU at matrix-matrix rates. "auto" picks dense when this worker's
-    #   share of the two planes fits dense_max_bytes
+    #   MXU at matrix-matrix rates. NOTE the bf16 planes QUANTIZE the stored
+    #   ratings to ~3 significant digits (8-bit mantissa: integer counts
+    #   above 256 and finely-graded explicit ratings round) — fine for
+    #   implicit confidence weights, a real numeric change for explicit
+    #   regression targets. "auto" therefore picks dense only in IMPLICIT
+    #   mode (when this worker's plane share fits dense_max_bytes) and
+    #   keeps explicit-rating runs on the exact f32 sparse path; request
+    #   layout="dense" explicitly to accept the quantization there
     dense_max_bytes: int = 2 * 1024 ** 3  # per-WORKER budget for the two
     #   bf16 plane shards (the SGDMFConfig.dense_max_bytes convention)
 
@@ -441,6 +447,10 @@ class ALS:
                              f"{cfg.layout!r}")
         if cfg.layout != "auto":
             return cfg.layout
+        if not cfg.implicit:
+            # bf16 planes quantize explicit training targets (see the
+            # ALSConfig.layout note) — auto never changes results silently
+            return "sparse"
         w = self.session.num_workers
         u_rpw = -(-num_users // w)
         i_rpw = -(-num_items // w)

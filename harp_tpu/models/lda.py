@@ -57,7 +57,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from harp_tpu.collectives import lax_ops, rotation
-from harp_tpu.parallel.mesh import WORKERS
+from harp_tpu.parallel.mesh import WORKERS, fetch
 from harp_tpu.session import HarpSession
 
 
@@ -426,10 +426,11 @@ class LDA:
         """Run training on already-placed device data (no host prep)."""
         key, data, seed, (word_block, word_slot, vpb) = state
         doc_topic, wt_out, z, ll = self._fns[key](*data, seed)
-        # un-permute word rows back to original vocab ids
-        wt_out = np.asarray(wt_out)
+        # un-permute word rows back to original vocab ids; fetch() gathers
+        # sharded outputs across gang processes (run.py gang CLI)
+        wt_out = fetch(wt_out)
         wt_final = wt_out[self._out_rows(key[0], word_block, word_slot, vpb)]
-        return np.asarray(doc_topic), wt_final, np.asarray(ll)
+        return fetch(doc_topic), wt_final, np.asarray(ll)
 
     def fit(self, docs: np.ndarray, seed: int = 0
             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -493,21 +494,21 @@ class LDA:
                 jnp.asarray(int(seed) + ep, jnp.int32))
             lls.extend(np.asarray(ll).tolist())
             ep += chunk
-            checkpointer.save(ep, {"z": np.asarray(z_cur),
-                                   "wt": np.asarray(wt_cur)})
+            checkpointer.save(ep, {"z": fetch(z_cur),
+                                   "wt": fetch(wt_cur)})
         if hasattr(checkpointer, "wait"):
             checkpointer.wait()       # surface a failed async final write
-        wt_out = np.asarray(wt_cur)
+        wt_out = fetch(wt_cur)
         wt_final = wt_out[self._out_rows(w, word_block, word_slot, vpb)]
         if doc_topic is not None:
-            dt = np.asarray(doc_topic)
+            dt = fetch(doc_topic)
         else:
             # checkpoint already covered every requested epoch: no chunk ran,
             # so rebuild doc_topic from the restored assignments z (counts of
             # each doc's unmasked tokens per topic — same formula as the
             # in-program init) instead of fabricating zeros
-            z_h = np.asarray(z_cur)
-            m_h = np.asarray(mask_b)
+            z_h = fetch(z_cur)
+            m_h = fetch(mask_b)
             if cfg.method == "cvb0":
                 dt = (z_h * m_h[..., None]).sum(axis=(1, 2))
             else:

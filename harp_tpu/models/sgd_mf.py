@@ -70,6 +70,7 @@ import numpy as np
 
 from harp_tpu.collectives import lax_ops, rotation
 from harp_tpu.ops import pallas_kernels
+from harp_tpu.parallel.mesh import fetch
 from harp_tpu.session import HarpSession
 
 
@@ -633,8 +634,8 @@ class SGDMF:
         """Device factor blocks → (num_rows, K)/(num_cols, K) in original id
         order (undo the worker/block permutation)."""
         num_rows, num_cols, row_assign, col_assign, rpw, cpb = meta[:6]
-        out_w = np.asarray(out_w)
-        out_h = np.asarray(out_h)
+        out_w = fetch(out_w)         # gathers sharded blocks across a gang
+        out_h = fetch(out_h)
         if self.config.num_slices == 2:
             # (W, 2, cpb, K) worker-major → block-id-major (2W*cpb, K)
             w_, _, cpb_, k = out_h.shape
@@ -753,8 +754,11 @@ class SGDMF:
                     f"{epochs} epochs — the saved model is already trained "
                     f"past this budget (pass a fresh checkpoint directory "
                     f"or a larger epochs)")
-            saved = checkpointer.restore(start, like={"w": np.asarray(w0),
-                                                      "h": np.asarray(h0)})
+            # `like` only conveys tree structure + dtypes: host zeros, not a
+            # full (gang-collective) D2H gather of the initial factors
+            saved = checkpointer.restore(
+                start, like={"w": np.zeros(w0.shape, w0.dtype),
+                             "h": np.zeros(h0.shape, h0.dtype)})
             w_cur = jax.device_put(saved["w"], w0.sharding)
             h_cur = jax.device_put(saved["h"], h0.sharding)
         key = self._program(layout, nmb, 1, geom)
@@ -764,8 +768,8 @@ class SGDMF:
             w_cur, h_cur, r = fn(*data, w_cur, h_cur)
             rmses.append(np.asarray(r)[0])
             if (epoch + 1) % save_every == 0 or epoch + 1 == epochs:
-                checkpointer.save(epoch + 1, {"w": np.asarray(w_cur),
-                                              "h": np.asarray(h_cur)})
+                checkpointer.save(epoch + 1, {"w": fetch(w_cur),
+                                              "h": fetch(h_cur)})
         if hasattr(checkpointer, "wait"):
             checkpointer.wait()     # surface a failed async final write
         w_final, h_final = self._finalize(w_cur, h_cur, meta)

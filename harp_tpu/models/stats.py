@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from harp_tpu.ops import linalg
+from harp_tpu.parallel.mesh import fetch
 from harp_tpu.session import HarpSession
 
 
@@ -47,7 +48,7 @@ class Covariance(_SPMDWrapper):
     def compute(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         fn = self._compile("cov", lambda a: linalg.covariance(a), 2)
         cov, mean = fn(self.session.scatter(jnp.asarray(x)))
-        return np.asarray(cov), np.asarray(mean)
+        return fetch(cov), fetch(mean)
 
 
 class LowOrderMoments(_SPMDWrapper):
@@ -56,7 +57,7 @@ class LowOrderMoments(_SPMDWrapper):
     def compute(self, x: np.ndarray) -> linalg.Moments:
         fn = self._compile("mom", lambda a: tuple(linalg.moments(a)), 10)
         out = fn(self.session.scatter(jnp.asarray(x)))
-        return linalg.Moments(*[np.asarray(o) for o in out])
+        return linalg.Moments(*[fetch(o) for o in out])
 
 
 class PCA(_SPMDWrapper):
@@ -65,7 +66,7 @@ class PCA(_SPMDWrapper):
     def fit(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         fn = self._compile("pca", lambda a: linalg.pca(a), 3)
         w, comps, mean = fn(self.session.scatter(jnp.asarray(x)))
-        return np.asarray(w), np.asarray(comps), np.asarray(mean)
+        return fetch(w), fetch(comps), fetch(mean)
 
     def fit_repeated(self, x, repeats: int
                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -103,7 +104,7 @@ class PCA(_SPMDWrapper):
             self._fns[key] = sess.spmd(fn, in_specs=(sess.shard(),),
                                        out_specs=(sess.replicate(),) * 3)
         out = self._fns[key](self.session.scatter(jnp.asarray(x)))
-        return tuple(np.asarray(o) for o in out)
+        return tuple(fetch(o) for o in out)
 
 
 class ZScore(_SPMDWrapper):
@@ -111,7 +112,7 @@ class ZScore(_SPMDWrapper):
 
     def transform(self, x: np.ndarray) -> np.ndarray:
         fn = self._compile("zscore", lambda a: linalg.zscore(a), 0, extra_sharded_out=1)
-        return np.asarray(fn(self.session.scatter(jnp.asarray(x))))
+        return fetch(fn(self.session.scatter(jnp.asarray(x))))
 
 
 class MinMax(_SPMDWrapper):
@@ -124,7 +125,7 @@ class MinMax(_SPMDWrapper):
     def transform(self, x: np.ndarray) -> np.ndarray:
         fn = self._compile("minmax", lambda a: linalg.minmax(a, self.lo, self.hi),
                            0, extra_sharded_out=1)
-        return np.asarray(fn(self.session.scatter(jnp.asarray(x))))
+        return fetch(fn(self.session.scatter(jnp.asarray(x))))
 
 
 class QR(_SPMDWrapper):
@@ -137,7 +138,7 @@ class QR(_SPMDWrapper):
                 lambda a: linalg.tsqr(a), in_specs=(sess.shard(),),
                 out_specs=(sess.shard(), sess.replicate()))
         q, r = self._fns["qr"](sess.scatter(jnp.asarray(x)))
-        return np.asarray(q), np.asarray(r)
+        return fetch(q), fetch(r)
 
 
 class PivotedQR(_SPMDWrapper):
@@ -148,7 +149,7 @@ class PivotedQR(_SPMDWrapper):
         fn = self._compile("pqr", lambda a: linalg.pivoted_qr(a), 2,
                            extra_sharded_out=1)
         q, r, piv = fn(self.session.scatter(jnp.asarray(x)))
-        return np.asarray(q), np.asarray(r), np.asarray(piv)
+        return fetch(q), fetch(r), fetch(piv)
 
 
 class SVD(_SPMDWrapper):
@@ -161,7 +162,7 @@ class SVD(_SPMDWrapper):
                 lambda a: linalg.svd_tall(a), in_specs=(sess.shard(),),
                 out_specs=(sess.shard(), sess.replicate(), sess.replicate()))
         u, s, vt = self._fns["svd"](sess.scatter(jnp.asarray(x)))
-        return np.asarray(u), np.asarray(s), np.asarray(vt)
+        return fetch(u), fetch(s), fetch(vt)
 
 
 class Cholesky(_SPMDWrapper):
@@ -169,7 +170,7 @@ class Cholesky(_SPMDWrapper):
 
     def compute(self, x: np.ndarray) -> np.ndarray:
         fn = self._compile("chol", lambda a: linalg.cholesky_gram(a), 1)
-        return np.asarray(fn(self.session.scatter(jnp.asarray(x))))
+        return fetch(fn(self.session.scatter(jnp.asarray(x))))
 
 
 class Quantiles(_SPMDWrapper):
@@ -179,7 +180,7 @@ class Quantiles(_SPMDWrapper):
         qs_arr = jnp.asarray(qs, jnp.float32)
         key = ("quantiles", tuple(np.asarray(qs).tolist()))
         fn = self._compile(key, lambda a: linalg.quantiles(a, qs_arr), 1)
-        return np.asarray(fn(self.session.scatter(jnp.asarray(x))))
+        return fetch(fn(self.session.scatter(jnp.asarray(x))))
 
 
 class Sorting(_SPMDWrapper):
@@ -187,7 +188,7 @@ class Sorting(_SPMDWrapper):
 
     def compute(self, x: np.ndarray) -> np.ndarray:
         fn = self._compile("sort", lambda a: linalg.distributed_sort(a), 1)
-        return np.asarray(fn(self.session.scatter(jnp.asarray(x))))
+        return fetch(fn(self.session.scatter(jnp.asarray(x))))
 
 
 class OutlierDetection(_SPMDWrapper):
@@ -201,4 +202,4 @@ class OutlierDetection(_SPMDWrapper):
         fn = self._compile(
             "outlier", lambda a: linalg.mahalanobis_outliers(a, self.threshold),
             0, extra_sharded_out=1)
-        return np.asarray(fn(self.session.scatter(jnp.asarray(x))))
+        return fetch(fn(self.session.scatter(jnp.asarray(x))))

@@ -104,3 +104,27 @@ def use_mesh(mesh: Mesh):
     """Context manager installing ``mesh`` as the ambient mesh."""
     with mesh:
         yield mesh
+
+
+def fetch(x) -> np.ndarray:
+    """Fetch a device array to a full host ndarray on EVERY process.
+
+    ``np.asarray`` on a jax.Array that spans non-addressable devices (a
+    sharded output under a multi-process gang) raises — the role Harp's
+    allgather-to-master played at job end (LDAMPCollectiveMapper's final
+    table gathers) here needs an explicit cross-process gather. Single
+    process (or replicated output): a plain, zero-collective ``np.asarray``.
+    Multi-process with non-addressable shards: ``process_allgather`` —
+    which is COLLECTIVE, so every process must reach this call (true for
+    all fit paths: SPMD processes run the same program).
+    """
+    if isinstance(x, np.ndarray):
+        return x
+    if (isinstance(x, jax.Array) and not x.is_fully_addressable
+            and not x.is_fully_replicated):
+        # replicated outputs skip this: np.asarray reads the local replica
+        # with zero collectives; only genuinely sharded spans pay the gather
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)

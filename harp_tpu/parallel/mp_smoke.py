@@ -112,6 +112,34 @@ def run(process_id: int, num_processes: int, port: int,
         cen, is_source=jax.process_index() == 0)
     np.testing.assert_array_equal(cen, cen0_proc)
 
+    # --- sharded-output fits across the gang: SGD-MF and LDA out_specs are
+    # SHARDED, so their final gathers ride mesh.fetch's process_allgather
+    # branch (advisor r4 medium: these crashed with "array spans
+    # non-addressable devices" under the gang CLI through round 3) --------- #
+    from harp_tpu.models import lda as plda
+    from harp_tpu.models import sgd_mf as smf
+
+    nr = world * 4
+    rng = np.random.default_rng(7)
+    flat = rng.choice(nr * nr, size=nr * 6, replace=False)
+    rr, cc = np.divmod(flat, nr)
+    vv = (rng.random(len(rr)) + 0.5).astype(np.float32)
+    mf = smf.SGDMF(sess, smf.SGDMFConfig(rank=4, epochs=2))
+    w_f, h_f, _ = mf.fit(rr.astype(np.int64), cc.astype(np.int64), vv, nr, nr)
+    assert w_f.shape == (nr, 4) and np.all(np.isfinite(w_f))
+    w_f0 = multihost_utils.broadcast_one_to_all(
+        w_f, is_source=jax.process_index() == 0)
+    np.testing.assert_array_equal(w_f, w_f0)
+
+    docs = rng.integers(0, 24, size=(world * 2, 8))
+    model_lda = plda.LDA(sess, plda.LDAConfig(num_topics=4, vocab=24,
+                                              epochs=2))
+    dt, wt, _ = model_lda.fit(docs)
+    assert dt.shape[0] == world * 2 and wt.shape == (24, 4)
+    dt0 = multihost_utils.broadcast_one_to_all(
+        dt, is_source=jax.process_index() == 0)
+    np.testing.assert_array_equal(dt, dt0)
+
     # --- host event control plane (multi-process branches) ------------------- #
     q = EventQueue()
     client = EventClient(q, worker_id=process_id)

@@ -23,15 +23,30 @@ publishes ``harp/p2p/<namespace>/<rank> = host:port`` and peers resolve
 lazily on first send (KV keys are write-once, so each transport generation
 needs its own ``kv_namespace``, agreed across the gang).
 
-Wire format: 8-byte big-endian length + pickle of ``(source, payload)``.
-Pickle over gang sockets matches the reference's trust model (it moved
-Java-serialized objects over its TCP links, HarpDAALComm.java:339) — gang
-members are mutually trusted; never point this at untrusted endpoints.
+Wire format: a per-connection handshake (server sends a 16-byte nonce, the
+client answers HMAC-SHA256(secret, nonce) — no frame is parsed before it
+verifies), then 8-byte big-endian length + pickle of ``(source, payload)``
+frames. Pickle over gang sockets matches the reference's trust model (it
+moved Java-serialized objects over its TCP links, HarpDAALComm.java:339) —
+gang members are mutually trusted — but pickle is code execution, so the
+transport (a) binds the advertised interface only, never 0.0.0.0, and (b)
+authenticates every connection when a secret is available: passed
+explicitly, or rendezvoused through the gang coordinator's KV store (rank 0
+generates and publishes it). Only coordinator-less explicit-peer setups
+(single-host tests) run unauthenticated, and those bind loopback by default.
+
+Delivery guarantee: sends are at-most-once. A peer that closes between the
+staleness probe and the write can absorb one frame silently (classic TCP
+FIN race — the reference's SyncClient had the same window); receivers must
+therefore always pass a ``timeout`` to ``wait_event`` and treat ``None`` as
+"peer gone or frame lost", not "bug".
 """
 
 from __future__ import annotations
 
+import hmac as _hmac
 import pickle
+import secrets as _secrets
 import socket
 import struct
 import threading
@@ -42,6 +57,8 @@ from harp_tpu.parallel.events import Event, EventQueue, EventType
 
 _LEN = struct.Struct(">Q")
 _KV_PREFIX = "harp/p2p/"
+_NONCE_LEN = 16
+_MAC_LEN = 32                       # SHA-256 digest size
 
 
 def _kv_client():
@@ -58,7 +75,11 @@ def _routable_host() -> str:
     """An address peers on other hosts can reach: the interface this process
     would use toward the gang coordinator (a connectionless UDP connect —
     nothing is sent), falling back to the hostname's address, then loopback
-    for coordinator-less single-host runs."""
+    for coordinator-less (or loopback-coordinated) single-host runs.
+
+    When the coordinator itself is NON-loopback — a real multi-host gang —
+    falling back to 127.0.0.1 would publish an address every peer resolves
+    to ITSELF (advisor r3): that case raises instead."""
     coord = None
     try:
         from jax._src import distributed as _jd
@@ -66,11 +87,11 @@ def _routable_host() -> str:
         coord = _jd.global_state.coordinator_address
     except Exception:
         pass
-    if coord:
+    coord_host = coord.rsplit(":", 1)[0] if coord else None
+    if coord_host:
         try:
-            host = coord.rsplit(":", 1)[0]
             with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
-                s.connect((host, 1))
+                s.connect((coord_host, 1))
                 return s.getsockname()[0]
         except OSError:
             pass
@@ -80,6 +101,13 @@ def _routable_host() -> str:
             return addr
     except OSError:
         pass
+    if coord_host and not (coord_host.startswith("127.")
+                           or coord_host in ("localhost", "::1")):
+        raise RuntimeError(
+            f"cannot determine a routable address for the p2p event plane: "
+            f"the gang coordinator is at {coord_host} (multi-host) but every "
+            f"interface probe failed — advertising 127.0.0.1 would make "
+            f"peers dial themselves; pass advertise_host explicitly")
     return "127.0.0.1"
 
 
@@ -105,9 +133,10 @@ class P2PTransport:
 
     def __init__(self, event_queue: EventQueue, rank: int,
                  peers: Optional[Dict[int, Tuple[str, int]]] = None,
-                 host: str = "0.0.0.0", port: int = 0,
+                 host: Optional[str] = None, port: int = 0,
                  advertise_host: Optional[str] = None,
                  kv_namespace: str = "default",
+                 secret: Optional[bytes] = None,
                  retries: int = 3, retry_sleep_s: float = 0.1,
                  connect_timeout_s: float = 30.0):
         self.queue = event_queue
@@ -125,11 +154,36 @@ class P2PTransport:
         self._retry_sleep_s = retry_sleep_s
         self._connect_timeout_s = connect_timeout_s
         self._closed = False
+        kv = _kv_client()
+        # connection auth (advisor r3): the frames are pickle, so an open
+        # unauthenticated port is arbitrary code execution. Resolve a gang
+        # secret — explicit > KV rendezvous (rank 0 generates, write-once
+        # key, peers block on it) > None (coordinator-less explicit-peer
+        # setups, which bind loopback below)
+        if secret is None and kv is not None and not self._explicit_peers:
+            # KV-rendezvous transports only: explicit-peer transports never
+            # touch the coordinator KV (keys are write-once — a second
+            # explicit-peer generation in the same namespace would collide)
+            skey = f"{self._kv_prefix}secret"
+            if rank == 0:
+                secret = _secrets.token_bytes(32)
+                kv.key_value_set(skey, secret.hex())
+            else:
+                secret = bytes.fromhex(kv.blocking_key_value_get(
+                    skey, int(connect_timeout_s * 1000)))
+        self._secret = secret
         # Server.java:40 — one listening socket per worker; the reference
         # derived port = 12800 + workerID (Constant.java:60), here the OS
-        # assigns one and the rendezvous publishes it. Bind all interfaces
-        # by default but ADVERTISE a routable address — publishing the bind
-        # host would hand multi-host peers 0.0.0.0/loopback
+        # assigns one and the rendezvous publishes it. Bind ONE interface,
+        # never 0.0.0.0 (advisor r3 — that published an unauthenticated
+        # pickle endpoint on every interface): with no auth secret, ONLY
+        # loopback is safe to listen on; with auth, the routable interface.
+        # advertise_host is what peers DIAL, not what we bind (NAT'd hosts
+        # advertise an address no local NIC owns) — pass ``host`` explicitly
+        # (e.g. "0.0.0.0") to split bind from advertise further.
+        if host is None:
+            host = ("127.0.0.1" if self._secret is None
+                    else _routable_host())
         self._server = socket.create_server((host, port))
         bound_port = self._server.getsockname()[1]
         if advertise_host is None:
@@ -140,11 +194,9 @@ class P2PTransport:
             target=self._accept_loop, daemon=True,
             name=f"harp-p2p-accept-{rank}")
         self._accept_thread.start()
-        if not self._explicit_peers:
-            client = _kv_client()
-            if client is not None:
-                client.key_value_set(f"{self._kv_prefix}{self.rank}",
-                                     f"{self.address[0]}:{self.address[1]}")
+        if not self._explicit_peers and kv is not None:
+            kv.key_value_set(f"{self._kv_prefix}{self.rank}",
+                             f"{self.address[0]}:{self.address[1]}")
 
     # ------------------------------------------------------------------ #
     # receive side (Server/Acceptor parity)
@@ -161,9 +213,33 @@ class P2PTransport:
             threading.Thread(target=self._reader, args=(conn,), daemon=True,
                              name=f"harp-p2p-reader-{self.rank}").start()
 
+    def _challenge(self, conn: socket.socket) -> bool:
+        """Server side of the connection handshake: nonce out, MAC back.
+        Returns False (caller closes) on a missing/invalid MAC — no frame
+        from an unauthenticated peer is ever unpickled."""
+        if self._secret is None:
+            return True
+        nonce = _secrets.token_bytes(_NONCE_LEN)
+        conn.settimeout(self._connect_timeout_s)
+        try:
+            conn.sendall(nonce)
+            mac = _recv_exact(conn, _MAC_LEN)
+        except OSError:
+            return False
+        finally:
+            conn.settimeout(None)
+        want = _hmac.new(self._secret, nonce, "sha256").digest()
+        return mac is not None and _hmac.compare_digest(mac, want)
+
     def _reader(self, conn: socket.socket) -> None:
         try:
             with conn:
+                if not self._challenge(conn):
+                    import logging
+
+                    logging.getLogger("harp_tpu.p2p").warning(
+                        "rejecting unauthenticated p2p connection")
+                    return
                 while True:
                     head = _recv_exact(conn, _LEN.size)
                     if head is None:
@@ -262,6 +338,17 @@ class P2PTransport:
                 if conn is None:
                     conn = socket.create_connection(
                         self._resolve(dest), timeout=self._connect_timeout_s)
+                    if self._secret is not None:
+                        # answer the server's challenge before any frame
+                        nonce = _recv_exact(conn, _NONCE_LEN)
+                        if nonce is None:
+                            raise OSError("peer closed during handshake")
+                        conn.sendall(_hmac.new(self._secret, nonce,
+                                               "sha256").digest())
+                    # keep the connect timeout as the SEND timeout: sendall
+                    # into a hung peer's full TCP window must raise into the
+                    # retry path, not block forever holding the per-dest lock
+                    conn.settimeout(self._connect_timeout_s)
                     with self._lock:
                         self._conns[dest] = conn
                 conn.sendall(frame)

@@ -106,6 +106,60 @@ def test_p2p_reconnects_after_peer_restart():
         t1b.close()
 
 
+def test_p2p_binds_loopback_without_gang():
+    """Coordinator-less explicit-peer transports must not listen on all
+    interfaces (advisor r3: an open unauthenticated pickle port is ACE)."""
+    q = EventQueue()
+    t = P2PTransport(q, rank=0, peers={})
+    try:
+        assert t.address[0] == "127.0.0.1"
+        assert t._server.getsockname()[0] == "127.0.0.1"
+    finally:
+        t.close()
+
+
+def test_p2p_hmac_handshake_accepts_and_rejects():
+    """Authenticated pair delivers; a wrong-secret client and a raw socket
+    that sends frames without answering the challenge are both rejected
+    before any frame is unpickled."""
+    import socket as sk
+    import pickle
+    import struct
+
+    q0, q1 = EventQueue(), EventQueue()
+    t0 = P2PTransport(q0, rank=0, peers={}, secret=b"gang-secret")
+    t1 = P2PTransport(q1, rank=1, peers={0: t0.address}, secret=b"gang-secret")
+    t0._peers[1] = t1.address
+    try:
+        t1.send(0, {"auth": True})
+        ev = q0.wait(timeout=30.0)
+        assert ev is not None and ev.payload == {"auth": True}
+
+        # wrong secret: server drops the connection; the send surfaces as a
+        # ConnectionError after retries instead of a silent delivery
+        q_bad = EventQueue()
+        t_bad = P2PTransport(q_bad, rank=2, peers={0: t0.address},
+                             secret=b"wrong", retries=2, retry_sleep_s=0.05,
+                             connect_timeout_s=2.0)
+        try:
+            t_bad.send(0, "evil")
+        except ConnectionError:
+            pass
+        t_bad.close()
+
+        # raw unauthenticated frame: never reaches the queue
+        body = pickle.dumps((9, "raw-evil"))
+        with sk.create_connection(t0.address, timeout=5.0) as raw:
+            raw.sendall(struct.pack(">Q", len(body)) + body)
+        import time
+
+        time.sleep(0.5)
+        assert q0.get() is None
+    finally:
+        t0.close()
+        t1.close()
+
+
 def test_p2p_concurrent_sends_do_not_interleave():
     """Frames from concurrent senders to one dest must never interleave on
     the pooled connection (per-dest send lock)."""
