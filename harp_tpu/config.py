@@ -36,10 +36,13 @@ DEFAULT_RUNTIME = RuntimeConfig()
 
 
 def add_dataclass_args(parser: argparse.ArgumentParser, cls: Type[T],
-                       prefix: str = "") -> None:
-    """Register one ``--flag`` per dataclass field (bool fields become on/off)."""
+                       prefix: str = "", skip: Optional[set] = None) -> None:
+    """Register one ``--flag`` per dataclass field (bool fields become on/off).
+    ``skip`` omits fields the caller registers itself (e.g. with choices)."""
     hints = get_type_hints(cls)
     for f in dataclasses.fields(cls):
+        if skip and f.name in skip:
+            continue
         name = f"--{prefix}{f.name.replace('_', '-')}"
         typ = hints.get(f.name, str)
         default = f.default if f.default is not dataclasses.MISSING else None

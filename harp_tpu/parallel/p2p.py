@@ -214,9 +214,12 @@ class P2PTransport:
                              name=f"harp-p2p-reader-{self.rank}").start()
 
     def _challenge(self, conn: socket.socket) -> bool:
-        """Server side of the connection handshake: nonce out, MAC back.
-        Returns False (caller closes) on a missing/invalid MAC — no frame
-        from an unauthenticated peer is ever unpickled."""
+        """Server side of the connection handshake: nonce out, MAC back,
+        one-byte ack out. Returns False (caller closes) on a missing/invalid
+        MAC — no frame from an unauthenticated peer is ever unpickled. The
+        ack is what makes a MISCONFIGURED sender fail loudly: without it the
+        client's first frame lands in its local TCP buffer and send()
+        reports success even though the server dropped the connection."""
         if self._secret is None:
             return True
         nonce = _secrets.token_bytes(_NONCE_LEN)
@@ -224,12 +227,15 @@ class P2PTransport:
         try:
             conn.sendall(nonce)
             mac = _recv_exact(conn, _MAC_LEN)
+            want = _hmac.new(self._secret, nonce, "sha256").digest()
+            ok = mac is not None and _hmac.compare_digest(mac, want)
+            if ok:
+                conn.sendall(b"\x01")
+            return ok
         except OSError:
             return False
         finally:
             conn.settimeout(None)
-        want = _hmac.new(self._secret, nonce, "sha256").digest()
-        return mac is not None and _hmac.compare_digest(mac, want)
 
     def _reader(self, conn: socket.socket) -> None:
         try:
@@ -339,12 +345,17 @@ class P2PTransport:
                     conn = socket.create_connection(
                         self._resolve(dest), timeout=self._connect_timeout_s)
                     if self._secret is not None:
-                        # answer the server's challenge before any frame
+                        # answer the server's challenge, then REQUIRE its
+                        # ack before pooling: a secret mismatch must raise
+                        # here, not silently drop buffered frames
                         nonce = _recv_exact(conn, _NONCE_LEN)
                         if nonce is None:
                             raise OSError("peer closed during handshake")
                         conn.sendall(_hmac.new(self._secret, nonce,
                                                "sha256").digest())
+                        if _recv_exact(conn, 1) != b"\x01":
+                            raise OSError(
+                                "p2p handshake rejected — secret mismatch?")
                     # keep the connect timeout as the SEND timeout: sendall
                     # into a hung peer's full TCP window must raise into the
                     # retry path, not block forever holding the per-dest lock

@@ -85,10 +85,10 @@ def _config_from_args(cls, ns, **overrides):
     return cls(**kwargs)
 
 
-def _add_config_flags(p, cls):
+def _add_config_flags(p, cls, skip=None):
     from harp_tpu.config import add_dataclass_args
 
-    add_dataclass_args(p, cls)
+    add_dataclass_args(p, cls, skip=skip)
 
 
 # --------------------------------------------------------------------------- #
@@ -531,29 +531,64 @@ def run_subgraph(argv) -> int:
 
 
 def run_svm(argv) -> int:
-    from harp_tpu.models.svm import SVMConfig
+    """daal_svm: ``--kernel linear`` trains the primal LinearSVM; rbf/poly
+    train the dual KernelSVM; ``--num-classes > 2`` runs the one-vs-one
+    MultiClassSVM (MultiClassDenseBatch parity)."""
+    from harp_tpu.models.svm import KernelSVMConfig, SVMConfig
 
     p = argparse.ArgumentParser(prog="harp_tpu.run svm")
     _common_flags(p)
     p.add_argument("--num-points", type=int, default=4096)
     p.add_argument("--dim", type=int, default=32)
-    _add_config_flags(p, SVMConfig)
+    p.add_argument("--num-classes", type=int, default=2)
+    p.add_argument("--kernel", default="linear",
+                   choices=["linear", "rbf", "poly"],
+                   help="linear = primal subgradient; rbf/poly = dual "
+                        "kernel machine (rotation-blocked Gram)")
+    _add_config_flags(p, KernelSVMConfig, skip={"kernel", "iterations"})
+    p.add_argument("--iterations", type=int, default=None,
+                   help="default: 200 primal / 400 dual (the per-path "
+                        "dataclass defaults)")
+    p.add_argument("--lr", type=float, default=0.1,
+                   help="primal (linear) path only")
     args = p.parse_args(argv)
     sess = _session(args)
     from harp_tpu.io import datagen
     from harp_tpu.models import svm
 
-    cfg = _config_from_args(svm.SVMConfig, args)
     n = args.num_points - args.num_points % sess.num_workers
-    x, y = datagen.classification_data(n, args.dim, 2, seed=args.seed)
+    k = max(2, args.num_classes)
+    x, y = datagen.classification_data(n, args.dim, k, seed=args.seed)
     t0 = time.perf_counter()
-    model = svm.LinearSVM(sess, cfg)
-    losses = model.fit(x, y)
-    dt = time.perf_counter() - t0
-    acc = (model.predict(x) == y).mean()
-    print(f"svm workers={sess.num_workers} n={n} d={args.dim}: "
-          f"{cfg.iterations / dt:.1f} iters/s (incl compile), "
-          f"hinge {losses[0]:.4f} -> {losses[-1]:.4f}, train acc {acc:.3f}")
+    if args.kernel == "linear" and k == 2:
+        cfg = svm.SVMConfig(c=args.c, lr=args.lr,
+                            iterations=args.iterations or 200)
+        model = svm.LinearSVM(sess, cfg)
+        losses = model.fit(x, y)
+        dt = time.perf_counter() - t0
+        acc = (model.predict(x) == y).mean()
+        print(f"svm[linear-primal] workers={sess.num_workers} n={n} "
+              f"d={args.dim}: {cfg.iterations / dt:.1f} iters/s (incl "
+              f"compile), hinge {losses[0]:.4f} -> {losses[-1]:.4f}, "
+              f"train acc {acc:.3f}")
+        return 0
+    kcfg = _config_from_args(svm.KernelSVMConfig, args, kernel=args.kernel)
+    if k == 2:
+        model = svm.KernelSVM(sess, kcfg)
+        duals = model.fit(x, y)
+        dt = time.perf_counter() - t0
+        acc = (model.predict(x) == y).mean()
+        print(f"svm[{args.kernel}-dual] workers={sess.num_workers} n={n} "
+              f"d={args.dim}: {kcfg.iterations / dt:.1f} iters/s (incl "
+              f"compile), dual {duals[0]:.2f} -> {duals[-1]:.2f}, "
+              f"{len(model.sv_x)} SVs, train acc {acc:.3f}")
+    else:
+        model = svm.MultiClassSVM(sess, kcfg).fit(x, y)
+        dt = time.perf_counter() - t0
+        acc = (model.predict(x) == y).mean()
+        print(f"svm[{args.kernel}-ovo] workers={sess.num_workers} n={n} "
+              f"d={args.dim} classes={k}: {len(model._machines)} machines "
+              f"in {dt:.1f}s, train acc {acc:.3f}")
     return 0
 
 

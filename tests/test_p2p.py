@@ -135,16 +135,14 @@ def test_p2p_hmac_handshake_accepts_and_rejects():
         ev = q0.wait(timeout=30.0)
         assert ev is not None and ev.payload == {"auth": True}
 
-        # wrong secret: server drops the connection; the send surfaces as a
-        # ConnectionError after retries instead of a silent delivery
+        # wrong secret: the server withholds its handshake ack, so the send
+        # DETERMINISTICALLY raises after retries — never silent frame loss
         q_bad = EventQueue()
         t_bad = P2PTransport(q_bad, rank=2, peers={0: t0.address},
                              secret=b"wrong", retries=2, retry_sleep_s=0.05,
                              connect_timeout_s=2.0)
-        try:
+        with pytest.raises(ConnectionError):
             t_bad.send(0, "evil")
-        except ConnectionError:
-            pass
         t_bad.close()
 
         # raw unauthenticated frame: never reaches the queue
