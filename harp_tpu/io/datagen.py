@@ -117,3 +117,15 @@ def regression_data(num_points: int, dim: int, num_targets: int = 1,
     x = rng.standard_normal((num_points, dim)).astype(np.float32)
     y = x @ beta + noise * rng.standard_normal((num_points, num_targets)).astype(np.float32)
     return x, y.astype(np.float32), beta
+
+
+def sparse_points(num_points: int, dim: int, density: float, seed: int = 0):
+    """Uniformly sparse COO feature matrix — synthetic input for the CSR
+    analytics family (daal_kmeans/allreducecsr, daal_cov/csrdistri,
+    daal_pca/corcsrdistr). Returns (rows, cols, vals)."""
+    rng = np.random.default_rng(seed)
+    nnz = max(1, int(density * num_points * dim))
+    flat = rng.choice(num_points * dim, size=nnz, replace=False)
+    rows, cols = np.divmod(flat, dim)
+    vals = rng.standard_normal(nnz).astype(np.float32)
+    return rows.astype(np.int64), cols.astype(np.int64), vals

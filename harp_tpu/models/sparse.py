@@ -1,0 +1,260 @@
+"""CSR (sparse-input) analytics — the reference's *csr* component family.
+
+Reference parity: daal_kmeans/allreducecsr (KmeansDaalCollectiveMapper.java:43,
+loadCSRNumericTable :155 — Lloyd's on CSR input with an allreduce of the
+centroid stats), daal_cov/csrdistri (CSR covariance), and daal_pca/corcsrdistr
+(correlation-method PCA from CSR input). Those were distinct DAAL kernels
+because MKL has separate sparse BLAS; here they are one shared layout plus two
+device expressions.
+
+TPU-native design — two different sparse strategies for the two access
+patterns:
+
+* **K-means E-step** (``sparse_kmeans_stats``): nnz-proportional work. Scores
+  need x·cᵀ only at observed coordinates: gather rows of cᵀ at the padded
+  column indices ((n_l, m, K) gather), weight by values, sum over m. The
+  M-step scatter Σ_{i∈k} x_i is a single ``segment_sum`` keyed by
+  ``assign·D + col`` — no (N, D) densification, no (N, K) distance matrix
+  beyond the one the dense path also makes. Per-row ‖x‖² is precomputed once
+  (the dense path's hoisted Σ‖x‖², VERDICT r3 item 4's recipe).
+* **Covariance/PCA gram** (``sparse_gram_stats``): XᵀX is densification-
+  friendly — a D-wide row block densifies into VMEM-sized tiles and the MXU
+  does the (D, B)×(B, D) product at matrix rates, which beats an
+  nnz²-per-row scatter for any realistic m. The scan densifies ``block``
+  rows at a time, so peak memory is (block, D), never (N, D).
+
+Layout: padded neighbor lists (``als.pad_csr_lists`` shape contract):
+``idx/val/mask (n_pad, m)`` with rows padded to a worker multiple and columns
+to the max row nnz. Zipf-skewed data should pre-balance rows across workers
+(the ALS capped-chunk layout is the heavier-duty option; K-means points are
+typically bounded-degree feature vectors, where max-nnz padding is tight).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from harp_tpu.collectives import lax_ops
+from harp_tpu.ops import linalg
+from harp_tpu.parallel.mesh import WORKERS
+from harp_tpu.session import HarpSession
+
+
+def csr_worker_layout(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
+                      num_rows: int, num_workers: int
+                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                 np.ndarray]:
+    """COO → padded per-row neighbor lists, rows padded to a worker multiple.
+
+    Returns (idx (n_pad, m), val, mask, real (n_pad,)). Row order is
+    preserved (row i of the output is data row i), so results align with
+    the dense path on the same matrix. ``real`` flags true DATA rows —
+    an all-zero data row is real (it counts toward n and may own a
+    centroid assignment); only the worker-multiple pad rows are not.
+    """
+    from harp_tpu.models.als import pad_csr_lists
+
+    rows = np.asarray(rows)
+    cols = np.asarray(cols)
+    vals = np.asarray(vals, np.float32)
+    if rows.size and (rows.min() < 0 or rows.max() >= num_rows):
+        raise ValueError(f"row ids must be in [0, {num_rows})")
+    idx, val, mask = pad_csr_lists(rows, cols, vals, num_rows, num_workers)
+    real = (np.arange(idx.shape[0]) < num_rows).astype(np.float32)
+    return idx, val, mask, real
+
+
+def sparse_kmeans_stats(idx, val, mask, real, x_sq, centroids,
+                        ) -> Tuple[jax.Array, jax.Array]:
+    """Fused sparse E-step: returns (stats (K, D+1), local cost).
+
+    scores[i, k] = ‖c_k‖² − 2 Σ_m val[i,m]·c[k, idx[i,m]]; the Σ‖x‖² row
+    constant drops from the argmin and returns in the cost (the dense
+    E-step's exact formulation, kmeans.py estep — tie-breaking matches).
+    """
+    k, d = centroids.shape
+    c2 = jnp.sum(centroids * centroids, axis=1)            # (K,)
+    ct = centroids.T                                       # (D, K)
+    vm = val * mask
+    xc = jnp.einsum("nm,nmk->nk", vm, ct[idx],
+                    preferred_element_type=jnp.float32)    # (n_l, K)
+    scores = c2[None, :] - 2.0 * xc
+    assign = jnp.argmin(scores, axis=1)                    # (n_l,)
+    min_s = jnp.min(scores, axis=1)
+    # M-step: scatter each nonzero into its row's centroid — one segment_sum
+    # keyed (assign, col) over the flattened nnz
+    keys = (assign[:, None] * d + idx).ravel()
+    sums = jax.ops.segment_sum(vm.ravel(), keys,
+                               num_segments=k * d).reshape(k, d)
+    counts = jax.ops.segment_sum(jnp.ones_like(assign, jnp.float32), assign,
+                                 num_segments=k)
+    stats = jnp.concatenate([sums, counts[:, None]], axis=1)
+    # phantom rows from the worker-multiple pad: their x=0 still assigns
+    # somewhere — remove them from the counts and cost (``real`` comes from
+    # the layout: an all-zero DATA row stays in, exactly like the dense path)
+    stats = stats.at[:, -1].add(-jax.ops.segment_sum(
+        1.0 - real, assign, num_segments=k))
+    cost = jnp.sum(real * (min_s + x_sq))
+    return stats, cost
+
+
+def sparse_gram_stats(idx, val, mask, real, dim: int, block: int = 512,
+                      axis_name: str = WORKERS):
+    """Global (XᵀX, Σx, n) from the padded-CSR shard — the csrdistri core.
+
+    Densifies ``block`` rows at a time inside a scan (peak (block, D)) and
+    runs the gram on the MXU; column sums ride one segment_sum.
+    """
+    n_l, m = idx.shape
+    b = min(block, n_l)
+    n_up = -(-n_l // b) * b
+    vm = val * mask
+    s_local = jax.ops.segment_sum(vm.ravel(), idx.ravel(), num_segments=dim)
+    if n_up != n_l:
+        # pad rows up to a block multiple (zero values add nothing to the
+        # gram) — shrinking the block to a divisor would degrade to b=1 on
+        # prime shard sizes
+        idx = jnp.pad(idx, ((0, n_up - n_l), (0, 0)))
+        vm = jnp.pad(vm, ((0, n_up - n_l), (0, 0)))
+    nb = n_up // b
+
+    def body(acc, blk):
+        bidx, bval = blk                         # (b, m)
+        dense = jnp.zeros((b, dim), jnp.float32).at[
+            jnp.arange(b)[:, None], bidx].add(bval)
+        return acc + jax.lax.dot_general(
+            dense, dense, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32), None
+
+    gram_local, _ = jax.lax.scan(
+        body, jnp.zeros((dim, dim), jnp.float32),
+        (idx.reshape(nb, b, m), vm.reshape(nb, b, m)))
+    gram = jax.lax.psum(gram_local, axis_name)
+    s = jax.lax.psum(s_local, axis_name)
+    n_real = jax.lax.psum(jnp.sum(real), axis_name)
+    return gram, s, n_real
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseKMeansConfig:
+    num_centroids: int = 10
+    dim: int = 100
+    iterations: int = 10
+
+
+class SparseKMeans:
+    """daal_kmeans/allreducecsr: Lloyd's on CSR points, stats allreduced.
+
+    Produces the same centroid trajectory as the dense KMeans on the
+    equivalent densified matrix (up to summation-order float noise — the
+    tests assert allclose, not bit equality, because gather-matmul and
+    dense-matmul reduce in different orders)."""
+
+    def __init__(self, session: HarpSession, config: SparseKMeansConfig):
+        self.session = session
+        self.config = config
+        self._fns = {}
+
+    def prepare(self, rows, cols, vals, num_points: int):
+        sess, cfg = self.session, self.config
+        idx, val, mask, real = csr_worker_layout(
+            rows, cols, vals, num_points, sess.num_workers)
+        if cols.size and int(np.max(cols)) >= cfg.dim:
+            raise ValueError(f"column id {int(np.max(cols))} >= dim {cfg.dim}")
+        x_sq = (val * val * mask).sum(axis=1).astype(np.float32)   # (n_pad,)
+        key = idx.shape
+        if key not in self._fns:
+            def fit_fn(i_, v_, m_, r_, xsq_, cen0):
+                def body(cen, _):
+                    stats, cost = sparse_kmeans_stats(i_, v_, m_, r_, xsq_,
+                                                      cen)
+                    full = lax_ops.allreduce(stats)
+                    new_c = full[:, :-1] / jnp.maximum(full[:, -1:], 1.0)
+                    return new_c, jax.lax.psum(cost, WORKERS)
+
+                return jax.lax.scan(body, cen0, None, length=cfg.iterations)
+
+            self._fns[key] = sess.spmd(
+                fit_fn, in_specs=(sess.shard(),) * 5 + (sess.replicate(),),
+                out_specs=(sess.replicate(), sess.replicate()))
+        return key, (sess.scatter(idx), sess.scatter(val), sess.scatter(mask),
+                     sess.scatter(real), sess.scatter(x_sq))
+
+    def fit_prepared(self, state, centroids0: np.ndarray
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+        """Run on prepared device data (the KMeans.prepare/fit_prepared
+        timing idiom: host layout + H2D stays out of timed regions)."""
+        key, placed = state
+        cen, costs = self._fns[key](
+            *placed, self.session.replicate_put(
+                jnp.asarray(centroids0, jnp.float32)))
+        return np.asarray(cen), np.asarray(costs)
+
+    def fit(self, rows, cols, vals, num_points: int,
+            centroids0: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        return self.fit_prepared(self.prepare(rows, cols, vals, num_points),
+                                 centroids0)
+
+
+class CSRCovariance:
+    """daal_cov/csrdistri: covariance + mean from CSR input."""
+
+    def __init__(self, session: HarpSession):
+        self.session = session
+        self._fns = {}
+
+    def _stats(self, rows, cols, vals, num_rows: int, dim: int):
+        sess = self.session
+        cols = np.asarray(cols)
+        if cols.size and (cols.min() < 0 or int(cols.max()) >= dim):
+            # jit scatters DROP out-of-bounds indices silently — validate
+            # here so the contract matches SparseKMeans.prepare
+            raise ValueError(f"column ids must be in [0, {dim}); got "
+                             f"[{cols.min()}, {cols.max()}]")
+        idx, val, mask, real = csr_worker_layout(rows, cols, vals, num_rows,
+                                                 sess.num_workers)
+        key = (idx.shape, dim)
+        if key not in self._fns:
+            def fn(i_, v_, m_, r_):
+                gram, s, n = sparse_gram_stats(i_, v_, m_, r_, dim)
+                mean = s / jnp.maximum(n, 1.0)
+                cov = (gram - n * jnp.outer(mean, mean)) / jnp.maximum(
+                    n - 1.0, 1.0)
+                return cov, mean
+
+            self._fns[key] = sess.spmd(
+                fn, in_specs=(sess.shard(),) * 4,
+                out_specs=(sess.replicate(), sess.replicate()))
+        return self._fns[key](sess.scatter(idx), sess.scatter(val),
+                              sess.scatter(mask), sess.scatter(real))
+
+    def compute(self, rows, cols, vals, num_rows: int, dim: int
+                ) -> Tuple[np.ndarray, np.ndarray]:
+        cov, mean = self._stats(rows, cols, vals, num_rows, dim)
+        return np.asarray(cov), np.asarray(mean)
+
+
+class CSRPCA:
+    """daal_pca/corcsrdistr: correlation-method PCA from CSR input.
+
+    The correlation derives from the CSR covariance; the (D, D) eigh runs
+    replicated exactly as the dense path (linalg.pca)."""
+
+    def __init__(self, session: HarpSession):
+        self.session = session
+        self._cov = CSRCovariance(session)
+
+    def fit(self, rows, cols, vals, num_rows: int, dim: int
+            ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        cov, mean = self._cov._stats(rows, cols, vals, num_rows, dim)
+        cov = np.asarray(cov)
+        d = np.sqrt(np.maximum(np.diag(cov), 1e-30))
+        corr = cov / np.outer(d, d)
+        w, v = np.linalg.eigh(corr)
+        order = np.argsort(-w)
+        return w[order], v[:, order].T, np.asarray(mean)

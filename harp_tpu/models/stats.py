@@ -61,10 +61,19 @@ class LowOrderMoments(_SPMDWrapper):
 
 
 class PCA(_SPMDWrapper):
-    """daal_pca/cordensedistr: correlation-method PCA."""
+    """daal_pca: ``method="cor"`` = cordensedistr (correlation eigh),
+    ``method="svd"`` = svddensedistr (z-score + distributed TSQR-SVD; same
+    eigenvalues, better conditioning at large D — linalg.pca_svd)."""
+
+    def __init__(self, session: HarpSession, method: str = "cor"):
+        super().__init__(session)
+        if method not in ("cor", "svd"):
+            raise ValueError(f"method must be cor|svd, got {method!r}")
+        self.method = method
 
     def fit(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        fn = self._compile("pca", lambda a: linalg.pca(a), 3)
+        impl = linalg.pca if self.method == "cor" else linalg.pca_svd
+        fn = self._compile(("pca", self.method), lambda a: impl(a), 3)
         w, comps, mean = fn(self.session.scatter(jnp.asarray(x)))
         return fetch(w), fetch(comps), fetch(mean)
 
@@ -79,9 +88,10 @@ class PCA(_SPMDWrapper):
         carry the fit itself produces (exactly 1.0 at runtime, unknowable at
         compile time), so XLA cannot hoist the loop-invariant gram/eigh out
         of the scan and fold ``repeats`` fits into one."""
-        key = ("pca_rep", repeats)
+        key = ("pca_rep", self.method, repeats)
         if key not in self._fns:
             sess = self.session
+            impl = linalg.pca if self.method == "cor" else linalg.pca_svd
 
             def fn(a):
                 d = a.shape[-1]
@@ -89,9 +99,10 @@ class PCA(_SPMDWrapper):
 
                 def body(carry, _):
                     s = carry[0]
-                    w, comps, mean = linalg.pca(a * s)
-                    # w[0] is the top correlation eigenvalue (>= 1e-30 by the
-                    # clamp in linalg.correlation), so s stays exactly 1.0
+                    w, comps, mean = impl(a * s)
+                    # w[0] is the top eigenvalue (>= 0; >= 1e-30 on the cor
+                    # path via linalg.correlation's clamp), so s stays
+                    # exactly 1.0 while staying runtime-dependent
                     s_next = jnp.asarray(1.0, dt) + jnp.asarray(0.0, dt) * w[0]
                     return (s_next, w, comps, mean), None
 

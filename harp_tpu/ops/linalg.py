@@ -181,6 +181,25 @@ def svd_tall(x: jax.Array, axis_name: str = WORKERS
     return q @ u_r, s, vt
 
 
+def pca_svd(x: jax.Array, axis_name: str = WORKERS
+            ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """PCA via distributed SVD of the z-scored data (daal_pca/svddensedistr).
+
+    DAAL's svd method normalizes then runs the SVD kernel; the correlation
+    eigenvalues are exactly s²/(n−1) of the z-scored matrix, so this method
+    and :func:`pca` agree on eigenvalues (the parity the tests assert) while
+    this one never forms the D×D correlation matrix — the better-conditioned
+    route when D is large or the correlation is near-singular.
+
+    Returns (eigenvalues desc (D,), components as rows (D, D), mean (D,)).
+    """
+    m = moments(x, axis_name)
+    z = (x - m.mean) / jnp.where(m.std_dev == 0, 1.0, m.std_dev)
+    _, s, vt = svd_tall(z, axis_name)            # s descending from jnp svd
+    w = s * s / jnp.maximum(m.count - 1.0, 1.0)
+    return w, vt, m.mean
+
+
 def cholesky_gram(x: jax.Array, axis_name: str = WORKERS) -> jax.Array:
     """Cholesky factor of the global gram matrix X'X (daal_cholesky applied to the
     distributed normal-equations matrix)."""

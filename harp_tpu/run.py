@@ -105,6 +105,12 @@ def run_kmeans(argv) -> int:
     p.add_argument("--save-every", type=int, default=0,
                    help="checkpoint centroids every N iterations into "
                         "work-dir (resumes automatically)")
+    p.add_argument("--format", default="dense", choices=["dense", "csr"],
+                   help="csr = sparse-input variant "
+                        "(daal_kmeans/allreducecsr); synthetic data is "
+                        "sparsified at --density")
+    p.add_argument("--density", type=float, default=0.05,
+                   help="synthetic sparsity for --format csr")
     _add_config_flags(p, KMeansConfig)
     args = p.parse_args(argv)
     if args.save_every and not args.work_dir:
@@ -117,6 +123,32 @@ def run_kmeans(argv) -> int:
     from harp_tpu.models import kmeans as km
 
     cfg = _config_from_args(km.KMeansConfig, args)
+    if args.format == "csr":
+        from harp_tpu.models import sparse as sp
+
+        if args.points_file or args.save_every or \
+                cfg.comm != "regroupallgather":
+            p.error("--format csr supports synthetic data with the fixed "
+                    "allreduce collective (daal_kmeans/allreducecsr) — "
+                    "--points-file/--save-every/--comm do not apply")
+        n = args.num_points - args.num_points % sess.num_workers
+        rows, cols, vals = datagen.sparse_points(n, cfg.dim, args.density,
+                                                 seed=args.seed)
+        dense0 = np.zeros((cfg.num_centroids, cfg.dim), np.float32)
+        head = rows < cfg.num_centroids
+        dense0[rows[head], cols[head]] = vals[head]
+        model = sp.SparseKMeans(sess, sp.SparseKMeansConfig(
+            cfg.num_centroids, cfg.dim, cfg.iterations))
+        state = model.prepare(rows, cols, vals, n)
+        model.fit_prepared(state, dense0)                  # compile+warm
+        t0 = time.perf_counter()
+        cen, costs = model.fit_prepared(state, dense0)
+        dt = time.perf_counter() - t0
+        print(f"kmeans[csr-allreduce] workers={sess.num_workers} n={n} "
+              f"k={cfg.num_centroids} d={cfg.dim} nnz={len(vals)}: "
+              f"{cfg.iterations / dt:.2f} iters/s, cost "
+              f"{costs[0]:.1f} -> {costs[-1]:.1f}")
+        return 0
     if args.points_file:
         pts = loaders.load_dense_csv([args.points_file])
     else:
@@ -300,6 +332,13 @@ def run_pca(argv) -> int:
     p.add_argument("--dim", type=int, default=256)
     p.add_argument("--iterations", type=int, default=5,
                    help="timed repeats")
+    p.add_argument("--method", default="cor", choices=["cor", "svd"],
+                   help="cor = cordensedistr; svd = svddensedistr "
+                        "(z-score + TSQR-SVD)")
+    p.add_argument("--format", default="dense", choices=["dense", "csr"],
+                   help="csr = daal_pca/corcsrdistr from sparse input")
+    p.add_argument("--density", type=float, default=0.05,
+                   help="synthetic sparsity for --format csr")
     args = p.parse_args(argv)
     sess = _session(args)
     import numpy as np
@@ -308,12 +347,38 @@ def run_pca(argv) -> int:
     from harp_tpu.models import stats
 
     n = args.num_points - args.num_points % sess.num_workers
+    if args.format == "csr":
+        from harp_tpu.models import sparse as sp
+
+        if args.method != "cor":
+            p.error("--format csr implements the correlation method only "
+                    "(daal_pca/corcsrdistr — the reference has no svd-csr "
+                    "variant)")
+        rows, cols, vals = datagen.sparse_points(n, args.dim, args.density,
+                                                 seed=args.seed)
+        t0 = time.perf_counter()
+        w, comps, mean = sp.CSRPCA(sess).fit(rows, cols, vals, n, args.dim)
+        dt = time.perf_counter() - t0
+        print(f"pca[csr] workers={sess.num_workers} n={n} d={args.dim} "
+              f"nnz={len(vals)}: fit in {dt:.2f}s (incl compile), top "
+              f"eigenvalue {w[0]:.4f}")
+        return 0
     x = datagen.dense_points(n, args.dim, seed=args.seed)
     # place once; re-scattering an already-placed array is a no-op, and the
     # repeats loop runs INSIDE one compiled program (stats.PCA.fit_repeated)
     # so the timing is compute, not transfers or per-call dispatch
     x_dev = sess.scatter(x)
-    model = stats.PCA(sess)
+    model = stats.PCA(sess, method=args.method)
+    if args.method == "svd":
+        # the repeated-fits-in-one-program harness is the correlation
+        # path's benchmark surface; svd runs plain fits
+        model.fit(x_dev)                          # compile + warmup
+        t0 = time.perf_counter()
+        w, comps, mean = model.fit(x_dev)
+        dt = time.perf_counter() - t0
+        print(f"pca[svd] workers={sess.num_workers} n={n} d={args.dim}: "
+              f"{1.0 / dt:.2f} fits/s, top eigenvalue {w[0]:.4f}")
+        return 0
     model.fit_repeated(x_dev, args.iterations)    # compile + warmup
     t0 = time.perf_counter()
     w, comps, mean = model.fit_repeated(x_dev, args.iterations)

@@ -126,3 +126,115 @@ def test_knn_k_guard(session):
         raise AssertionError("expected ValueError")
     except ValueError as e:
         assert "rows per worker" in str(e)
+
+
+# --------------------------------------------------------------------------- #
+# CSR analytics variants (daal_kmeans/allreducecsr, daal_cov/csrdistri,
+# daal_pca/corcsrdistr) + PCA method="svd" (daal_pca/svddensedistr)
+# --------------------------------------------------------------------------- #
+
+
+@pytest.fixture(scope="module")
+def sparse_coo():
+    """A sparsified dataset: ~10% density, 192 rows x 24 cols."""
+    rng = np.random.default_rng(23)
+    n, d = 192, 24
+    dense = np.zeros((n, d), np.float32)
+    nnz = int(0.1 * n * d)
+    flat = rng.choice(n * d, size=nnz, replace=False)
+    rows, cols = np.divmod(flat, d)
+    vals = rng.standard_normal(nnz).astype(np.float32)
+    dense[rows, cols] = vals
+    return rows.astype(np.int64), cols.astype(np.int64), vals, dense
+
+
+def test_sparse_kmeans_matches_dense(session):
+    """Well-separated sparse clusters (disjoint column groups): the sparse
+    and dense E-steps must produce the same trajectory. Random near-tied
+    data would flip argmins on summation-order noise — separation makes the
+    comparison meaningful."""
+    from harp_tpu.models import kmeans as km
+    from harp_tpu.models import sparse
+
+    rng = np.random.default_rng(3)
+    n, d, k, gcols = 192, 24, 4, 6
+    dense = np.zeros((n, d), np.float32)
+    rows_l, cols_l, vals_l = [], [], []
+    for i in range(n):
+        g = i % k
+        cset = g * gcols + rng.choice(gcols, 3, replace=False)
+        v = (5.0 + 0.5 * rng.standard_normal(3)).astype(np.float32)
+        dense[i, cset] = v
+        rows_l += [i] * 3
+        cols_l += cset.tolist()
+        vals_l += v.tolist()
+    rows = np.asarray(rows_l, np.int64)
+    cols = np.asarray(cols_l, np.int64)
+    vals = np.asarray(vals_l, np.float32)
+    cen0 = dense[:k].copy()
+    dcfg = km.KMeansConfig(num_centroids=k, dim=d, iterations=6,
+                           comm="allreduce")
+    dcen, dcost = km.KMeans(session, dcfg).fit(dense, cen0)
+    scfg = sparse.SparseKMeansConfig(num_centroids=k, dim=d, iterations=6)
+    scen, scost = sparse.SparseKMeans(session, scfg).fit(
+        rows, cols, vals, n, cen0)
+    np.testing.assert_allclose(scen, np.asarray(dcen), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(scost, np.asarray(dcost), rtol=1e-4)
+
+
+def test_sparse_kmeans_phantom_row_padding(session):
+    """A row count NOT divisible by the workers: internal phantom rows must
+    not perturb counts or cost (numpy-oracle comparison on separated
+    clusters, 189 % 8 != 0)."""
+    from harp_tpu.models import sparse
+    from harp_tpu.models.kmeans import numpy_reference
+
+    rng = np.random.default_rng(9)
+    n, d, k = 189, 16, 3
+    dense = np.zeros((n, d), np.float32)
+    rows_l, cols_l, vals_l = [], [], []
+    for i in range(n):
+        g = i % k
+        c = g * 5 + rng.choice(5, 2, replace=False)
+        v = (4.0 + 0.3 * rng.standard_normal(2)).astype(np.float32)
+        dense[i, c] = v
+        rows_l += [i, i]
+        cols_l += c.tolist()
+        vals_l += v.tolist()
+    cen0 = dense[:k].copy()
+    scfg = sparse.SparseKMeansConfig(num_centroids=k, dim=d, iterations=4)
+    scen, _ = sparse.SparseKMeans(session, scfg).fit(
+        np.asarray(rows_l, np.int64), np.asarray(cols_l, np.int64),
+        np.asarray(vals_l, np.float32), n, cen0)
+    ref = numpy_reference(dense, cen0.copy(), 4)
+    np.testing.assert_allclose(scen, ref, rtol=1e-3, atol=1e-3)
+
+
+def test_csr_covariance_and_pca_match_dense(session, sparse_coo):
+    from harp_tpu.models import sparse
+
+    rows, cols, vals, dense = sparse_coo
+    n, d = dense.shape
+    cov, mean = sparse.CSRCovariance(session).compute(rows, cols, vals, n, d)
+    np.testing.assert_allclose(mean, dense.mean(0), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(cov, np.cov(dense, rowvar=False), rtol=1e-3,
+                               atol=1e-4)
+    w, comps, _ = sparse.CSRPCA(session).fit(rows, cols, vals, n, d)
+    wd, compsd, _ = stats.PCA(session).fit(dense)
+    np.testing.assert_allclose(w, wd, rtol=1e-3, atol=1e-4)
+    # eigenvectors match up to sign
+    dots = np.abs(np.sum(comps * compsd, axis=1))
+    np.testing.assert_allclose(dots[:5], 1.0, atol=1e-2)
+
+
+def test_pca_svd_method_matches_correlation(session, data):
+    """daal_pca/svddensedistr parity: the svd method's eigenvalues equal the
+    correlation method's (z-score + TSQR-SVD route)."""
+    w_cor, comps_cor, mean_cor = stats.PCA(session, method="cor").fit(data)
+    w_svd, comps_svd, mean_svd = stats.PCA(session, method="svd").fit(data)
+    np.testing.assert_allclose(w_svd, w_cor, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(mean_svd, mean_cor, rtol=1e-5, atol=1e-5)
+    dots = np.abs(np.sum(comps_svd * comps_cor, axis=1))
+    np.testing.assert_allclose(dots[:6], 1.0, atol=1e-2)
+    with pytest.raises(ValueError):
+        stats.PCA(session, method="eig")
