@@ -64,6 +64,22 @@ def csr_worker_layout(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
     vals = np.asarray(vals, np.float32)
     if rows.size and (rows.min() < 0 or rows.max() >= num_rows):
         raise ValueError(f"row ids must be in [0, {num_rows})")
+    if cols.size and cols.min() < 0:
+        # a negative id would silently clamp in device gathers / drop in
+        # scatters — the same trap the dim upper-bound checks close
+        raise ValueError(f"column ids must be nonnegative; got {cols.min()}")
+    if rows.size:
+        # duplicate (row, col) entries SUM — densification semantics, so
+        # every consumer (scores, grams, x_sq) agrees with the dense path
+        span = int(cols.max()) + 1
+        key = rows.astype(np.int64) * span + cols.astype(np.int64)
+        uniq, inv = np.unique(key, return_inverse=True)
+        if len(uniq) < len(key):
+            vsum = np.zeros(len(uniq), np.float32)
+            np.add.at(vsum, inv, vals)
+            rows = (uniq // span).astype(rows.dtype)
+            cols = (uniq % span).astype(cols.dtype)
+            vals = vsum
     idx, val, mask = pad_csr_lists(rows, cols, vals, num_rows, num_workers)
     real = (np.arange(idx.shape[0]) < num_rows).astype(np.float32)
     return idx, val, mask, real
@@ -162,10 +178,11 @@ class SparseKMeans:
 
     def prepare(self, rows, cols, vals, num_points: int):
         sess, cfg = self.session, self.config
-        idx, val, mask, real = csr_worker_layout(
-            rows, cols, vals, num_points, sess.num_workers)
+        cols = np.asarray(cols)
         if cols.size and int(np.max(cols)) >= cfg.dim:
             raise ValueError(f"column id {int(np.max(cols))} >= dim {cfg.dim}")
+        idx, val, mask, real = csr_worker_layout(
+            rows, cols, vals, num_points, sess.num_workers)
         x_sq = (val * val * mask).sum(axis=1).astype(np.float32)   # (n_pad,)
         key = idx.shape
         if key not in self._fns:

@@ -116,21 +116,22 @@ def run_kmeans(argv) -> int:
     if args.save_every and not args.work_dir:
         # argparse usage error — fail before data gen / session / prepare
         p.error("--save-every requires --work-dir (nowhere to checkpoint)")
+    cfg = _config_from_args(KMeansConfig, args)
+    if args.format == "csr" and (args.points_file or args.save_every
+                                 or cfg.comm != "regroupallgather"):
+        # same fail-before-session idiom as the --save-every guard
+        p.error("--format csr supports synthetic data with the fixed "
+                "allreduce collective (daal_kmeans/allreducecsr) — "
+                "--points-file/--save-every/--comm do not apply")
     sess = _session(args)
     import numpy as np
 
     from harp_tpu.io import datagen, loaders
     from harp_tpu.models import kmeans as km
 
-    cfg = _config_from_args(km.KMeansConfig, args)
     if args.format == "csr":
         from harp_tpu.models import sparse as sp
 
-        if args.points_file or args.save_every or \
-                cfg.comm != "regroupallgather":
-            p.error("--format csr supports synthetic data with the fixed "
-                    "allreduce collective (daal_kmeans/allreducecsr) — "
-                    "--points-file/--save-every/--comm do not apply")
         n = args.num_points - args.num_points % sess.num_workers
         rows, cols, vals = datagen.sparse_points(n, cfg.dim, args.density,
                                                  seed=args.seed)

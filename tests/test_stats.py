@@ -136,16 +136,15 @@ def test_knn_k_guard(session):
 
 @pytest.fixture(scope="module")
 def sparse_coo():
-    """A sparsified dataset: ~10% density, 192 rows x 24 cols."""
-    rng = np.random.default_rng(23)
+    """A sparsified dataset: ~10% density, 192 rows x 24 cols — generated by
+    the SAME helper the CLI uses (io.datagen.sparse_points)."""
+    from harp_tpu.io import datagen
+
     n, d = 192, 24
+    rows, cols, vals = datagen.sparse_points(n, d, 0.1, seed=23)
     dense = np.zeros((n, d), np.float32)
-    nnz = int(0.1 * n * d)
-    flat = rng.choice(n * d, size=nnz, replace=False)
-    rows, cols = np.divmod(flat, d)
-    vals = rng.standard_normal(nnz).astype(np.float32)
     dense[rows, cols] = vals
-    return rows.astype(np.int64), cols.astype(np.int64), vals, dense
+    return rows, cols, vals, dense
 
 
 def test_sparse_kmeans_matches_dense(session):
