@@ -195,10 +195,13 @@ class Quantiles(_SPMDWrapper):
 
 
 class Sorting(_SPMDWrapper):
-    """daal_sorting: column-wise sort of all rows."""
+    """daal_sorting: column-wise sort of all rows (distributed odd-even
+    block sort — the device output is SHARDED in global sorted order;
+    compute() assembles the full matrix on the host via fetch)."""
 
     def compute(self, x: np.ndarray) -> np.ndarray:
-        fn = self._compile("sort", lambda a: linalg.distributed_sort(a), 1)
+        fn = self._compile("sort", lambda a: linalg.distributed_sort(a), 0,
+                           extra_sharded_out=1)
         return fetch(fn(self.session.scatter(jnp.asarray(x))))
 
 

@@ -203,6 +203,27 @@ def test_ring_attention_mha_matches_ulysses_and_reference(session):
     np.testing.assert_allclose(np.asarray(ring), ref, rtol=2e-3, atol=2e-3)
 
 
+def test_blocked_attention_matches_reference_all_block_sizes():
+    """The streamed-KV inner attention (what ulysses now runs) is exact for
+    every block size, causal and not — including blocks that split the
+    causal boundary."""
+    rng = np.random.default_rng(13)
+    for l in (48, 47):           # 47: prime length exercises the KV padding
+        h, d = 2, 8
+        q = jnp.asarray(rng.standard_normal((l, h, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((l, h, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((l, h, d)), jnp.float32)
+        for causal in (False, True):
+            ref = jax.vmap(
+                lambda qh, kh, vh: ring_attention.reference_attention(
+                    qh, kh, vh, causal), in_axes=1, out_axes=1)(q, k, v)
+            for blk in (5, 16, 48, 512):
+                got = ring_attention.blocked_attention(q, k, v, causal,
+                                                       kv_block=blk)
+                np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                           rtol=2e-4, atol=2e-5)
+
+
 def test_ulysses_attention_matches_reference(session):
     rng = np.random.default_rng(9)
     l, h, dh = 64, 8, 8

@@ -83,10 +83,14 @@ def test_cholesky(session, data):
 
 
 def test_quantiles_and_sort(session, data):
-    qs = [0.1, 0.5, 0.9]
+    # includes the extremes: q=0 reads worker 0's first row, q=1 the last
+    # worker's last row — the owner-boundary cases of the distributed
+    # order-statistic pick
+    qs = [0.0, 0.1, 0.5, 0.9, 1.0]
     q = stats.Quantiles(session).compute(data, qs)
     np.testing.assert_allclose(q, np.quantile(data, qs, axis=0), rtol=1e-4,
                                atol=1e-4)
+    # the distributed odd-even block sort assembles to the full column sort
     s = stats.Sorting(session).compute(data)
     np.testing.assert_allclose(s, np.sort(data, axis=0), rtol=1e-6)
 
