@@ -74,10 +74,11 @@ def measure(widths=(1, 2, 4, 8, 16, 32, 64), n=65536, d=64, k=64, iters=20,
         # participants measure scheduler contention, not collective layout
         cw = min(8, max(widths))
         sess8 = HarpSession(num_workers=cw, devices=jax.devices()[:cw])
+        # full BenchmarkMapper parity: bcast (java:77) and reduce included
         for r in bench_collectives(sess8, sizes_kb=[1024], loops=20,
-                                   ops=("allreduce", "allgather",
-                                        "reduce_scatter", "rotate",
-                                        "all_to_all")):
+                                   ops=("broadcast", "reduce", "allreduce",
+                                        "allgather", "reduce_scatter",
+                                        "rotate", "all_to_all")):
             coll[r.op] = {"size_bytes": r.size_bytes,
                           "us_per_op": round(r.us_per_op, 1),
                           "gbps": round(r.gbps, 2)}
