@@ -10,18 +10,16 @@ device expressions.
 TPU-native design — two different sparse strategies for the two access
 patterns:
 
-* **K-means E-step** (``sparse_kmeans_stats``): nnz-proportional work. Scores
-  need x·cᵀ only at observed coordinates: gather rows of cᵀ at the padded
-  column indices ((n_l, m, K) gather), weight by values, sum over m. The
-  M-step scatter Σ_{i∈k} x_i is a single ``segment_sum`` keyed by
-  ``assign·D + col`` — no (N, D) densification, no (N, K) distance matrix
-  beyond the one the dense path also makes. Per-row ‖x‖² is precomputed once
+* **K-means E-step** (``sparse_kmeans_stats``): block-densify-GEMM by
+  default — scatter-free densification (one-hot·value reduce) of a
+  (block, D) tile, then MXU GEMMs for scores and M-step sums; 13× the
+  gather strategy on chip (docstring there). A ``gather`` strategy
+  (cᵀ-row gathers + segment_sum, nnz-proportional compute) is kept for
+  the very-sparse-very-wide regime. Per-row ‖x‖² is precomputed once
   (the dense path's hoisted Σ‖x‖², VERDICT r3 item 4's recipe).
-* **Covariance/PCA gram** (``sparse_gram_stats``): XᵀX is densification-
-  friendly — a D-wide row block densifies into VMEM-sized tiles and the MXU
-  does the (D, B)×(B, D) product at matrix rates, which beats an
-  nnz²-per-row scatter for any realistic m. The scan densifies ``block``
-  rows at a time, so peak memory is (block, D), never (N, D).
+* **Covariance/PCA gram** (``sparse_gram_stats``): the same blocked
+  densify, with the MXU running (D, B)×(B, D) at matrix rates. The scan
+  keeps peak memory at (block, D), never (N, D).
 
 Layout: padded neighbor lists (``als.pad_csr_lists`` shape contract):
 ``idx/val/mask (n_pad, m)`` with rows padded to a worker multiple and columns
@@ -86,17 +84,76 @@ def csr_worker_layout(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
 
 
 def sparse_kmeans_stats(idx, val, mask, real, x_sq, centroids,
+                        strategy: str = "densify", block: int = 1024,
                         ) -> Tuple[jax.Array, jax.Array]:
     """Fused sparse E-step: returns (stats (K, D+1), local cost).
 
     scores[i, k] = ‖c_k‖² − 2 Σ_m val[i,m]·c[k, idx[i,m]]; the Σ‖x‖² row
     constant drops from the argmin and returns in the cost (the dense
     E-step's exact formulation, kmeans.py estep — tie-breaking matches).
+
+    Two strategies, picked by where the bytes go on TPU:
+
+    * ``densify`` (default): scan over ``block``-row tiles — densify the
+      tile's nonzeros into a (block, D) buffer, then score (GEMM against
+      cᵀ) and accumulate the M-step (one-hotᵀ GEMM) on the MXU. Compute
+      matches the dense E-step; the sparsity saves STORAGE (O(nnz)
+      resident vs O(N·D)). Measured r4 on the chip (n=262k, d=256,
+      density 5%): 119.9 iters/s vs gather's 9.1 (13×) — and the densify
+      itself must avoid XLA scatter (one-hot·value reduce instead; the
+      `.at[].add` version measured 13.7, scatter-serialization-bound).
+    * ``gather``: nnz-proportional compute via cᵀ-row gathers + one
+      segment_sum scatter. Fewer FLOPs, but 128-byte-granule gathers run
+      ~25M rows/s on v5e (the measured wall) — only wins when the data is
+      so sparse-and-wide that nnz·K reads beat N·D·4 streaming bytes.
     """
     k, d = centroids.shape
     c2 = jnp.sum(centroids * centroids, axis=1)            # (K,)
     ct = centroids.T                                       # (D, K)
     vm = val * mask
+    if strategy == "densify":
+        n_l, m = idx.shape
+        b = min(block, n_l)
+        n_up = -(-n_l // b) * b
+        if n_up != n_l:                 # zero rows: real=0 excludes them
+            idx = jnp.pad(idx, ((0, n_up - n_l), (0, 0)))
+            vm = jnp.pad(vm, ((0, n_up - n_l), (0, 0)))
+            real = jnp.pad(real, (0, n_up - n_l))
+            x_sq = jnp.pad(x_sq, (0, n_up - n_l))
+        nb = n_up // b
+
+        def body(carry, blk):
+            sums_a, counts_a, cost_a = carry
+            bidx, bvm, breal, bxsq = blk
+            # densify WITHOUT xla scatter (.at[].add measured 8.8x slower
+            # here): one-hot × value, reduced over the neighbor axis —
+            # pure vectorized VPU work that XLA fuses
+            dense = jnp.sum(
+                jax.nn.one_hot(bidx, d, dtype=jnp.float32)
+                * bvm[..., None], axis=1)                  # (b, D)
+            scores = c2[None, :] - 2.0 * jax.lax.dot_general(
+                dense, ct, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)        # (b, K)
+            assign = jnp.argmin(scores, axis=1)
+            min_s = jnp.min(scores, axis=1)
+            onehot = jax.nn.one_hot(assign, k, dtype=jnp.float32)
+            onehot = onehot * breal[:, None]               # drop phantoms
+            sums_a = sums_a + jax.lax.dot_general(
+                onehot, dense, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            counts_a = counts_a + jnp.sum(onehot, axis=0)
+            cost_a = cost_a + jnp.sum(breal * (min_s + bxsq))
+            return (sums_a, counts_a, cost_a), None
+
+        (sums, counts, cost), _ = jax.lax.scan(
+            body,
+            (jnp.zeros((k, d), jnp.float32), jnp.zeros((k,), jnp.float32),
+             jnp.zeros((), jnp.float32)),
+            (idx.reshape(nb, b, m), vm.reshape(nb, b, m),
+             real.reshape(nb, b), x_sq.reshape(nb, b)))
+        return jnp.concatenate([sums, counts[:, None]], axis=1), cost
+    if strategy != "gather":
+        raise ValueError(f"strategy must be densify|gather, got {strategy!r}")
     xc = jnp.einsum("nm,nmk->nk", vm, ct[idx],
                     preferred_element_type=jnp.float32)    # (n_l, K)
     scores = c2[None, :] - 2.0 * xc
@@ -141,8 +198,10 @@ def sparse_gram_stats(idx, val, mask, real, dim: int, block: int = 512,
 
     def body(acc, blk):
         bidx, bval = blk                         # (b, m)
-        dense = jnp.zeros((b, dim), jnp.float32).at[
-            jnp.arange(b)[:, None], bidx].add(bval)
+        # scatter-free densify (one-hot·value reduce) — XLA scatter was
+        # 8.8x slower on the same pattern in the K-means E-step
+        dense = jnp.sum(jax.nn.one_hot(bidx, dim, dtype=jnp.float32)
+                        * bval[..., None], axis=1)
         return acc + jax.lax.dot_general(
             dense, dense, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32), None
@@ -161,6 +220,7 @@ class SparseKMeansConfig:
     num_centroids: int = 10
     dim: int = 100
     iterations: int = 10
+    strategy: str = "densify"   # densify | gather (sparse_kmeans_stats doc)
 
 
 class SparseKMeans:
@@ -184,12 +244,12 @@ class SparseKMeans:
         idx, val, mask, real = csr_worker_layout(
             rows, cols, vals, num_points, sess.num_workers)
         x_sq = (val * val * mask).sum(axis=1).astype(np.float32)   # (n_pad,)
-        key = idx.shape
+        key = (idx.shape, cfg.strategy)
         if key not in self._fns:
             def fit_fn(i_, v_, m_, r_, xsq_, cen0):
                 def body(cen, _):
                     stats, cost = sparse_kmeans_stats(i_, v_, m_, r_, xsq_,
-                                                      cen)
+                                                      cen, cfg.strategy)
                     full = lax_ops.allreduce(stats)
                     new_c = full[:, :-1] / jnp.maximum(full[:, -1:], 1.0)
                     return new_c, jax.lax.psum(cost, WORKERS)

@@ -241,3 +241,31 @@ def test_pca_svd_method_matches_correlation(session, data):
     np.testing.assert_allclose(dots[:6], 1.0, atol=1e-2)
     with pytest.raises(ValueError):
         stats.PCA(session, method="eig")
+
+
+def test_sparse_kmeans_strategies_agree(session, sparse_coo):
+    """densify (MXU tiles) and gather (nnz-proportional) E-steps produce the
+    same stats on the same shard — one iteration, no argmin compounding."""
+    import jax.numpy as jnp
+
+    from harp_tpu.models import sparse
+
+    rows, cols, vals, dense = sparse_coo
+    n, d = dense.shape
+    idx, val, mask, real = sparse.csr_worker_layout(rows, cols, vals, n, 1)
+    x_sq = (val * val * mask).sum(axis=1).astype(np.float32)
+    cen = dense[:5].copy() + 0.01
+    out = {}
+    for strat in ("densify", "gather"):
+        stats, cost = sparse.sparse_kmeans_stats(
+            jnp.asarray(idx), jnp.asarray(val), jnp.asarray(mask),
+            jnp.asarray(real), jnp.asarray(x_sq), jnp.asarray(cen), strat)
+        out[strat] = (np.asarray(stats), float(cost))
+    np.testing.assert_allclose(out["densify"][0], out["gather"][0],
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(out["densify"][1], out["gather"][1],
+                               rtol=1e-4)
+    with pytest.raises(ValueError):
+        sparse.sparse_kmeans_stats(
+            jnp.asarray(idx), jnp.asarray(val), jnp.asarray(mask),
+            jnp.asarray(real), jnp.asarray(x_sq), jnp.asarray(cen), "csr")
