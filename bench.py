@@ -32,7 +32,7 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 # K-means (BASELINE configs[0] — flagship, primary metric)
 # --------------------------------------------------------------------------- #
 
-def tpu_kmeans_iters_per_sec(n, k, d, iters):
+def tpu_kmeans_iters_per_sec(n, k, d, iters, compute_dtype="float32"):
     import jax.numpy as jnp
     from harp_tpu.io import datagen
     from harp_tpu.models import kmeans as km
@@ -44,7 +44,8 @@ def tpu_kmeans_iters_per_sec(n, k, d, iters):
     n_eff = pts.shape[0] - pts.shape[0] % sess.num_workers
     pts = pts[:n_eff]
 
-    model = km.KMeans(sess, km.KMeansConfig(k, d, iters, "regroupallgather"))
+    model = km.KMeans(sess, km.KMeansConfig(k, d, iters, "regroupallgather",
+                                            compute_dtype=compute_dtype))
     pts_dev, cen_dev = model.prepare(pts, datagen.initial_centroids(pts, k, seed=3))
     _, costs = model.fit_prepared(pts_dev, cen_dev)   # compile + warmup
     np.asarray(costs)  # fetch forces execution (block_until_ready is async on
@@ -62,7 +63,8 @@ def tpu_kmeans_iters_per_sec(n, k, d, iters):
     # read twice (distance GEMM + stats GEMM); centroid/stat traffic is
     # K-sized noise. achieved bytes/s vs the v5e roofline answers "is it
     # actually fast", which vs-one-CPU-core cannot.
-    bytes_per_iter = 2.0 * n_eff * d * 4
+    bytes_per_point = 2 if compute_dtype == "bfloat16" else 4
+    bytes_per_iter = 2.0 * n_eff * d * bytes_per_point
     hbm_pct = 100.0 * bytes_per_iter * best / (
         V5E_HBM_GBPS * sess.num_workers)
     return best, final_cost, hbm_pct
@@ -521,6 +523,11 @@ def main():
 
     tpu_ips, final_cost, km_hbm_pct = tpu_kmeans_iters_per_sec(n, k, d,
                                                               tpu_iters)
+    # bf16 point storage halves the E-step's dominant bytes; accumulations
+    # stay f32 (kmeans.py compute_dtype contract) — the cost row shows the
+    # convergence is unchanged
+    bf16_ips, bf16_cost, _ = tpu_kmeans_iters_per_sec(
+        n, k, d, tpu_iters, compute_dtype="bfloat16")
     cpu_ips = cpu_kmeans_iters_per_sec(n, k, d, cpu_iters)
     skm_n, skm_d = (16384, 128) if small else (262144, 256)
     skm_ips, skm_nnz = tpu_sparse_kmeans_iters_per_sec(
@@ -582,6 +589,8 @@ def main():
         "baseline_cpu_iters_per_sec": round(cpu_ips, 3),
         "final_cost": final_cost,
         "kmeans_hbm_roofline_pct": round(km_hbm_pct, 1),
+        "kmeans_bf16_iters_per_sec": round(bf16_ips, 3),
+        "kmeans_bf16_final_cost": bf16_cost,
         "kmeans_vs_xeon36_lb": xeon_lb(tpu_ips / cpu_ips),
         "kmeans_csr_iters_per_sec": round(skm_ips, 2),
         "kmeans_csr_config": f"n={skm_n} d={skm_d} density=0.05 "

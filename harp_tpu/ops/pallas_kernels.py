@@ -46,19 +46,24 @@ def _kmeans_tile_kernel(x_ref, c_ref, sums_ref, counts_ref, cost_ref,
     the one-hot instead; (3) scalar accumulators need a lane-width (1, 128)
     block."""
     i = pl.program_id(0)
-    x = x_ref[...]                              # (block_n, D)
-    c = c_ref[...]                              # (K, D)
+    x = x_ref[...]                              # (block_n, D) f32 or bf16
+    c = c_ref[...]                              # (K, D) f32
     # score = ‖c‖² − 2x·c (row-constant ‖x‖² dropped from the argmin; its sum
     # is added back to the cost as a scalar). Avoids (block_n, 1) temporaries,
-    # which mosaic lowers poorly.
-    c2 = jnp.sum(c * c, axis=1)[None, :]
+    # which mosaic lowers poorly. bf16 points: MXU takes bf16 operands with
+    # f32 accumulation; norms/scores/stats all stay f32 (the kmeans.py
+    # compute_dtype contract).
+    cf = c.astype(jnp.float32)
+    c2 = jnp.sum(cf * cf, axis=1)[None, :]
+    c_mm = c.astype(x.dtype)                    # match operand dtypes
     s = c2 - 2.0 * jax.lax.dot_general(
-        x, c, (((1,), (1,)), ((), ())),
+        x, c_mm, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)        # (block_n, K) in VMEM
     assign = jnp.argmin(s, axis=1)
     onehot = jax.nn.one_hot(assign, k, dtype=x.dtype)
     min_sum = jnp.sum(onehot * s)
-    x_sq = jnp.sum(x * x)
+    xf = x.astype(jnp.float32)
+    x_sq = jnp.sum(xf * xf)
 
     @pl.when(i == 0)
     def _init():
@@ -69,7 +74,10 @@ def _kmeans_tile_kernel(x_ref, c_ref, sums_ref, counts_ref, cost_ref,
     sums_ref[...] += jax.lax.dot_general(
         onehot, x, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
-    counts_ref[...] += jnp.sum(onehot, axis=0)[None, :]
+    # counts reduce in f32: a bf16 one-hot cannot represent integer sums
+    # past 256 (the same rule distance.py and kmeans.py state; the hardware
+    # block_n <= 256 bound masks it, interpret mode does not)
+    counts_ref[...] += jnp.sum(onehot.astype(jnp.float32), axis=0)[None, :]
     cost_ref[...] += jnp.full((1, 128), min_sum + x_sq, jnp.float32)
 
 
@@ -100,6 +108,7 @@ def kmeans_stats_pallas(
     d_pad = -(-d // 128) * 128
     k_pad = -(-k // 8) * 8
     k_orig, d_orig = k, d
+    c = c.astype(jnp.float32)       # centroids stay f32 (norm precision)
     if d_pad != d:
         x = jnp.pad(x, ((0, 0), (0, d_pad - d)))
         c = jnp.pad(c, ((0, 0), (0, d_pad - d)))
@@ -395,17 +404,20 @@ def kmeans_stats(x: jax.Array, c: jax.Array, block_n: int = 256,
     """Dispatch: pallas when opted in (HARP_USE_PALLAS=1) on TPU, else XLA.
 
     This is the E-step entry the K-means model calls. Opt-in rather than
-    default: the XLA path is already HBM-bandwidth-bound optimal for this op
-    on v5e (the two matmuls fuse well), while mosaic compile time for large
-    grids is minutes on remote-compile setups — pay it only when you ask to.
-    The pallas path computes in f32 and derives Σ‖x‖² in-kernel, so
-    ``compute_dtype``/``x_sq_sum`` apply to the XLA path only.
+    default: the XLA path fuses the two matmuls well and the kernel TIES
+    it at BOTH storage dtypes (measured r4 bench config: XLA 828 f32 /
+    918 bf16 iters/s vs pallas 877 / 895 — the hypothesis that XLA's
+    score materialization would dominate at bf16 did not survive
+    measurement), while mosaic compile time for large grids is minutes on
+    remote-compile setups — pay it only when you ask to. Accepts f32 or
+    bf16 ``x``; scores/stats always accumulate f32 and Σ‖x‖² derives
+    in-kernel (``x_sq_sum`` applies to the XLA path only).
     """
     import os
 
     on_tpu = jax.default_backend() == "tpu"
     opted = os.environ.get("HARP_USE_PALLAS", "") == "1"
     if (_HAVE_PALLAS and on_tpu and opted and x.shape[0] % block_n == 0
-            and x.dtype == jnp.float32):
+            and x.dtype in (jnp.float32, jnp.bfloat16)):
         return kmeans_stats_pallas(x, c, block_n)
     return xla_path.partial_sums_counts(x, c, compute_dtype, x_sq_sum)

@@ -119,6 +119,20 @@ def test_pallas_kmeans_kernel_interpret_matches_xla():
     np.testing.assert_allclose(np.asarray(counts), np.asarray(counts_ref),
                                rtol=1e-6)
     np.testing.assert_allclose(float(cost), float(cost_ref), rtol=1e-4)
+    # bf16 point storage: compare like-for-like against the XLA path fed
+    # the SAME bf16 points (f32-vs-bf16 comparisons flip near-tie
+    # assignments and move whole rows between cluster sums)
+    x16 = x.astype(jnp.bfloat16)
+    s_ref16, c_ref16, cost_ref16 = distance.partial_sums_counts(
+        x16, c, compute_dtype=jnp.bfloat16)   # bf16 cross term, like pallas
+    sums16, counts16, cost16 = pallas_kernels.kmeans_stats_pallas(
+        x16, c, block_n=64, interpret=True)
+    assert float(jnp.sum(counts16)) == x.shape[0]
+    np.testing.assert_allclose(np.asarray(counts16), np.asarray(c_ref16),
+                               atol=1)
+    np.testing.assert_allclose(np.asarray(sums16), np.asarray(s_ref16),
+                               rtol=2e-2, atol=0.2)
+    np.testing.assert_allclose(float(cost16), float(cost_ref16), rtol=2e-2)
 
 
 def test_pallas_spd_solve_interpret_matches_scipy():
