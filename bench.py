@@ -449,6 +449,33 @@ def tpu_sparse_kmeans_iters_per_sec(n, k, d, density, iters):
     return best, len(vals)
 
 
+def tpu_attention_tokens_per_sec(l=16384, h=8, dh=64, reps=10):
+    """Long-context blocked attention at the per-chip length SP exists for
+    (the r3 full-softmax path needed 8 GB of temps here — PERF.md). Causal,
+    one chip; the multi-chip ring adds the ppermute hops on top."""
+    import jax
+    import jax.numpy as jnp
+
+    from harp_tpu.parallel import ring_attention as ra
+
+    q = jax.random.normal(jax.random.key(0), (l, h, dh), jnp.float32)
+
+    def run(q0):
+        def body(c, _):
+            o = ra.blocked_attention(c, c, c, causal=True)
+            return c + 1e-20 * o, ()        # carry dependence: no hoisting
+
+        out, _ = jax.lax.scan(body, q0, None, length=reps)
+        return out
+
+    fn = jax.jit(run)
+    np.asarray(fn(q))                        # compile + warm (D2H forces)
+    t0 = time.perf_counter()
+    jax.block_until_ready(fn(q))
+    dt = time.perf_counter() - t0
+    return l * reps / dt
+
+
 def p2p_event_rtt_us(rounds=200):
     """Host event-plane round trip (send → wait_event → reply → wait): the
     latency the true P2P transport (authenticated, loopback here) delivers.
@@ -575,6 +602,9 @@ def main():
         nn_n, nn_d, epochs=3 if small else 50)
     nn_cpu = cpu_nn_samples_per_sec(nn_n, nn_d, epochs=1)
 
+    attn_l = 2048 if small else 16384
+    attn_tps = tpu_attention_tokens_per_sec(l=attn_l)
+
     mesh = mesh_scaling_and_collectives()
     try:
         rtt_us = p2p_event_rtt_us()
@@ -633,6 +663,8 @@ def main():
             f"lower bound on the ratio vs BASELINE.md's 2x18-core Haswell "
             f"(assumes perfect 36x anchor scaling AND Haswell==Zen "
             f"per-core; both favor the Xeon)"),
+        "attention_tokens_per_sec": round(attn_tps),
+        "attention_config": f"blocked causal L={attn_l} H=8 Dh=64 (1 chip)",
         "p2p_event_rtt_us": rtt_us,
         "scaling_efficiency": mesh.get("scaling_efficiency", mesh),
         "collectives_8w_cpu_mesh": mesh.get("collectives", {}),

@@ -140,6 +140,17 @@ def run(process_id: int, num_processes: int, port: int,
         dt, is_source=jax.process_index() == 0)
     np.testing.assert_array_equal(dt, dt0)
 
+    # stats family: QR's Q is SHARDED output — the third fetch consumer
+    from harp_tpu.models import stats as pstats
+
+    # TSQR needs local rows >= D: world*8 rows over `world` workers, D=6
+    xq = rng.standard_normal((world * 8, 6)).astype(np.float32)
+    q_mat, r_mat = pstats.QR(sess).compute(xq)
+    np.testing.assert_allclose(q_mat @ r_mat, xq, rtol=1e-3, atol=1e-3)
+    q0_mat = multihost_utils.broadcast_one_to_all(
+        q_mat, is_source=jax.process_index() == 0)
+    np.testing.assert_array_equal(q_mat, q0_mat)
+
     # --- host event control plane (multi-process branches) ------------------- #
     q = EventQueue()
     client = EventClient(q, worker_id=process_id)

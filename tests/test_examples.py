@@ -21,6 +21,14 @@ def test_collectives_tour_runs():
     assert "allreduce" in out.stdout and "rotate" in out.stdout
 
 
+def test_analytics_tour_runs():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "analytics_tour.py")],
+        env=ENV, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "ANALYTICS TOUR OK" in out.stdout
+
+
 def test_kmeans_launcher_cli(tmp_path):
     work = str(tmp_path / "km")
     out = subprocess.run(
