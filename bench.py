@@ -449,10 +449,12 @@ def tpu_sparse_kmeans_iters_per_sec(n, k, d, density, iters):
     return best, len(vals)
 
 
-def tpu_attention_tokens_per_sec(l=16384, h=8, dh=64, reps=10):
-    """Long-context blocked attention at the per-chip length SP exists for
-    (the r3 full-softmax path needed 8 GB of temps here — PERF.md). Causal,
-    one chip; the multi-chip ring adds the ppermute hops on top."""
+def tpu_attention_tokens_per_sec(l=16384, h=8, dh=64, reps=100):
+    """Long-context blocked attention (pallas flash at L >= 8192) at the
+    per-chip length SP exists for (the r3 full-softmax path needed 8 GB of
+    temps here — PERF.md). Causal, one chip; the multi-chip ring adds the
+    ppermute hops on top. 100 in-program reps keep the ~0.1 s tunnel
+    dispatch near ~5% of the timed call at flash speed (~19 ms/pass)."""
     import jax
     import jax.numpy as jnp
 

@@ -20,6 +20,10 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import jax                                             # noqa: E402
 
+# NOT a no-op on TPU images whose sitecustomize force-selects the hardware
+# backend via jax.config.update (which OVERRIDES the env var) — calling
+# update back is the only way to honor JAX_PLATFORMS=cpu there (the same
+# guard every example/test harness in this repo uses; see tests/conftest.py)
 if os.environ.get("JAX_PLATFORMS", "") == "cpu":
     jax.config.update("jax_platforms", "cpu")
 

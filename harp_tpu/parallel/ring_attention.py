@@ -160,6 +160,16 @@ def blocked_attention(qf: jax.Array, kf: jax.Array, vf: jax.Array,
     """
     l_full, h, dh = qf.shape
     dv = vf.shape[-1]
+    # TPU + long sequences: the fused pallas flash kernel holds each
+    # query tile's running stats/accumulator in VMEM across the KV grid
+    # (this XLA scan round-trips them through HBM every step) — measured
+    # 2.5x at L>=8192 (14 TFLOP/s effective at L=16k); below the 8192
+    # crossover the XLA scan stays ahead and remains the path (PERF.md
+    # r4). Opt out with HARP_FLASH_PALLAS=0.
+    from harp_tpu.ops import pallas_kernels as _pk
+
+    if dv == dh and _pk.use_flash_pallas(l_full):
+        return _pk.flash_attention_pallas(qf, kf, vf, causal)
     b = min(kv_block, l_full)
     # pad the KV axis up to a block multiple (padded keys masked by
     # position) — a largest-divisor fallback would degrade to b=1 scans on
