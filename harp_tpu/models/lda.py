@@ -193,19 +193,31 @@ class LDA:
                 else:
                     nw = wt_block[wl_g] - cur
                 nk = tt_local[None, None, :] - cur
-                logits = (jnp.log(jnp.maximum(nd + cfg.alpha, 1e-10))
-                          + jnp.log(jnp.maximum(nw + cfg.beta, 1e-10))
-                          - jnp.log(jnp.maximum(nk + cfg.vocab * cfg.beta,
-                                                1e-10)))
+                # PRODUCT space, not log space: p ∝ (nd+α)(nw+β)/(nk+Vβ)
+                # directly. The log form cost 3 transcendentals per (token,
+                # topic) and jax.random.categorical's gumbel trick 2 more —
+                # ~5K logs/token of pure VPU-transcendental work at K
+                # topics; inverse-CDF sampling needs ZERO (measured r4:
+                # 39 → 68M tokens/s on the bench config). All factors are
+                # nonnegative (counts exclude self) and bounded by doc
+                # length/corpus counts, so f32 products are safe — the
+                # sequential oracle uses the identical form.
+                p = (jnp.maximum(nd + cfg.alpha, 0.0)
+                     * jnp.maximum(nw + cfg.beta, 0.0)
+                     / jnp.maximum(nk + cfg.vocab * cfg.beta, 1e-10))
                 if soft:
                     # CVB0 (contrib/lda CVB0 LdaMapCollective): deterministic
                     # mean-field update — soft assignment = normalized
-                    # probabilities instead of a sample
-                    zs_new = jax.nn.softmax(logits, axis=-1) * ms_g[..., None]
+                    # probabilities (softmax(log p) ≡ p/Σp, minus the logs)
+                    zs_new = (p / jnp.maximum(p.sum(-1, keepdims=True),
+                                              1e-30)) * ms_g[..., None]
                     new = zs_new
                 else:
                     key, sub = jax.random.split(key)
-                    zs_new = jax.random.categorical(sub, logits, axis=-1)
+                    cdf = jnp.cumsum(p, axis=-1)
+                    u = jax.random.uniform(sub, p.shape[:-1] + (1,),
+                                           jnp.float32) * cdf[..., -1:]
+                    zs_new = jnp.clip(jnp.sum((cdf < u), axis=-1), 0, k - 1)
                     new = (jax.nn.one_hot(zs_new, k, dtype=jnp.float32)
                            * ms_g[..., None])
                 delta = new - cur                             # (dg, Lb, K)
