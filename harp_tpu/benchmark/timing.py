@@ -1,0 +1,60 @@
+"""Shared two-point timing protocol (r5 measurement-rigor pass).
+
+The axon tunnel charges a ~0.1-0.4 s constant dispatch + D2H tax per program
+call, and the tax DRIFTS within a process — single-call wall clocks are
+meaningless and sequential lo-then-hi runs bias the delta. The protocol every
+harness uses (bench.py, lda_stages, nn_budget):
+
+* compile the same workload at a LOW and a HIGH in-program iteration count;
+* run reps ALTERNATING lo/hi so drift hits both medians equally;
+* rate = d(wall-median) / d(iters) — the constant tax cancels;
+* guard the noise floor: a non-positive delta falls back to the wall rate of
+  the high count (the workload is all fixed cost at this size) and is
+  visible in the spread columns.
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+from typing import Callable, Dict
+
+
+def two_point_timers(timer_lo: Callable[[], None],
+                     timer_hi: Callable[[], None],
+                     lo: int, hi: int, units_per_iter: float,
+                     reps: int = 3) -> Dict:
+    """Measure prepared (compiled + warmed) timers at two iteration counts.
+
+    Each timer runs ONE dispatch and blocks until results are real on host.
+    Returns rate (units/s), per_iter_ms, fixed_dispatch_s, spread_pct and the
+    raw samples."""
+    s_lo, s_hi = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        timer_lo()
+        s_lo.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        timer_hi()
+        s_hi.append(time.perf_counter() - t0)
+    med_lo, med_hi = statistics.median(s_lo), statistics.median(s_hi)
+    per_iter = (med_hi - med_lo) / (hi - lo)
+    if per_iter <= 0:  # noise floor: the workload is all fixed cost
+        per_iter = max(med_hi / hi, 1e-9)
+    return {
+        "rate": units_per_iter / per_iter,
+        "per_iter_ms": round(per_iter * 1e3, 4),
+        "fixed_dispatch_s": round(max(med_lo - lo * per_iter, 0.0), 3),
+        "spread_pct": round(100 * (max(s_hi) - min(s_hi)) / med_hi, 1),
+        "iters_lo_hi": [lo, hi],
+        "samples_s": {"lo": [round(t, 4) for t in s_lo],
+                      "hi": [round(t, 4) for t in s_hi]},
+    }
+
+
+def two_point(build: Callable[[int], Callable[[], None]], lo: int, hi: int,
+              units_per_iter: float, reps: int = 3) -> Dict:
+    """build(n) compiles + warms the workload at n in-program iterations and
+    returns its one-dispatch timer; see :func:`two_point_timers`."""
+    return two_point_timers(build(lo), build(hi), lo, hi, units_per_iter,
+                            reps)

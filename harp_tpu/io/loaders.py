@@ -62,7 +62,17 @@ def list_files(spec: str) -> List[str]:
             # detail=True: one listing RPC, not one isdir stat per entry
             entries = fs.ls(path, detail=True)
         else:
-            entries = fs.glob(path, detail=True).values()
+            # fs.glob(detail=True) only exists on recent fsspec (ADVICE r4);
+            # plain glob + per-entry info keeps older releases working
+            try:
+                got = fs.glob(path, detail=True)
+            except TypeError:
+                got = None
+            if isinstance(got, dict):
+                entries = got.values()
+            else:
+                entries = [fs.info(n) for n in (got if got is not None
+                                                else fs.glob(path))]
         names = [e["name"] for e in entries if e.get("type") != "directory"]
         return sorted(fs.unstrip_protocol(n) for n in names)
     import glob as _glob

@@ -84,6 +84,10 @@ class LDAConfig:
     ablate_rotation: bool = False  # timing ablation ONLY: keep the exact
     #   compute schedule but skip the ppermute (results are wrong — blocks
     #   never move); lets benchmark/lda_overlap.py price the rotation
+    ablate_stage: str = ""      # timing ablation ONLY ("gather" | "scatter" |
+    #   "sample" | "gather+scatter"): drop that stage of the per-group update
+    #   (results are wrong) so benchmark/lda_stages.py can price each stage of
+    #   the hop by difference — the per-stage budget VERDICT r4 asked for
     minibatches_per_hop: int = 4  # sequential doc-group sub-steps per hop:
     #   fully-parallel draws let every token of a word resample against the
     #   SAME stale word-topic row each round (a word's tokens can never
@@ -139,6 +143,15 @@ class LDA:
         if config.num_model_slices not in (1, 2):
             raise ValueError(f"num_model_slices must be 1 or 2, got "
                              f"{config.num_model_slices}")
+        if config.ablate_stage not in ("", "gather", "scatter", "sample",
+                                       "gather+scatter"):
+            raise ValueError(
+                f"ablate_stage must be ''|gather|scatter|sample|"
+                f"gather+scatter, got {config.ablate_stage!r}")
+        if config.ablate_stage == "sample" and config.method == "cvb0":
+            raise ValueError(
+                "ablate_stage='sample' only supports method='cgs' (the "
+                "cheap-shift replacement needs integer topic assignments)")
         self.session = session
         self.config = config
         self._fns = {}
@@ -186,13 +199,43 @@ class LDA:
                     cur = (jax.nn.one_hot(zs_g, k, dtype=jnp.float32)
                            * ms_g[..., None])
                 nd = dt_g[:, None, :] - cur                   # exclude self
-                if use_gemm:
+                no_gather = "gather" in cfg.ablate_stage
+                no_scatter = "scatter" in cfg.ablate_stage
+                oh = None
+                if use_gemm and not (no_gather and no_scatter):
+                    # the scatter GEMM needs the one-hot even when the
+                    # gather is ablated (building it is part of either
+                    # stage's cost in gemm mode)
                     oh = jax.nn.one_hot(wl_g.reshape(-1), vpb,
                                         dtype=jnp.float32)   # (dg*Lb, vpb)
+                if no_gather:
+                    nw = 1.0 - cur                # ablation: skip the wt read
+                elif use_gemm:
                     nw = (oh @ wt_block).reshape(cur.shape) - cur
                 else:
                     nw = wt_block[wl_g] - cur
                 nk = tt_local[None, None, :] - cur
+                if cfg.ablate_stage == "sample":
+                    # ablation: keep gather+scatter live (consume nw, emit a
+                    # nonzero delta) but skip the categorical build + draw
+                    gate = (nw.sum(-1) > 1e30).astype(jnp.int32)
+                    zs_cheap = (zs_g + 1 + gate) % k
+                    new = (jax.nn.one_hot(zs_cheap, k, dtype=jnp.float32)
+                           * ms_g[..., None])
+                    delta = new - cur
+                    if not no_scatter:
+                        if use_gemm:
+                            wt_block = wt_block + jax.lax.dot_general(
+                                oh, delta.reshape(-1, k),
+                                (((0,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+                        else:
+                            wt_block = wt_block + jax.ops.segment_sum(
+                                delta.reshape(-1, k), wl_g.reshape(-1),
+                                num_segments=vpb)
+                    d_k = delta.sum(axis=(0, 1))
+                    return (wt_block, tt_local + d_k, d_k, key,
+                            zs_cheap, dt_g + delta.sum(axis=1))
                 # PRODUCT space, not log space: p ∝ (nd+α)(nw+β)/(nk+Vβ)
                 # directly. The log form cost 3 transcendentals per (token,
                 # topic) and jax.random.categorical's gumbel trick 2 more —
@@ -221,7 +264,9 @@ class LDA:
                     new = (jax.nn.one_hot(zs_new, k, dtype=jnp.float32)
                            * ms_g[..., None])
                 delta = new - cur                             # (dg, Lb, K)
-                if use_gemm:
+                if no_scatter:
+                    pass                         # ablation: skip the wt write
+                elif use_gemm:
                     wt_block = wt_block + jax.lax.dot_general(
                         oh, delta.reshape(-1, k), (((0,), (0,)), ((), ())),
                         preferred_element_type=jnp.float32)

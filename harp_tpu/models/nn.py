@@ -32,6 +32,10 @@ class NNConfig:
     momentum: float = 0.9
     batch_size: int = 32                 # per worker
     epochs: int = 10
+    ablate_allreduce: bool = False       # timing ablation ONLY: drop the
+    #   per-minibatch gradient pmean (results are wrong under W>1 — workers
+    #   diverge); benchmark/nn_budget.py uses it to price the allreduce
+    #   share of the step budget (VERDICT r4 weak #1)
 
 
 def init_params(dims: Sequence[int], seed: int = 0) -> List:
@@ -75,8 +79,9 @@ def _train(x, y, params0, cfg: NNConfig, axis_name: str = WORKERS):
         params, vel = carry
         bx, by = xs
         loss, g = grad_fn(params, bx, by)
-        loss = jax.lax.pmean(loss, axis_name)
-        g = jax.lax.pmean(g, axis_name)                 # the allreduce
+        if not cfg.ablate_allreduce:
+            loss = jax.lax.pmean(loss, axis_name)
+            g = jax.lax.pmean(g, axis_name)             # the allreduce
         vel = jax.tree.map(lambda v, gi: cfg.momentum * v - cfg.lr * gi, vel, g)
         params = jax.tree.map(lambda p, v: p + v, params, vel)
         return (params, vel), loss

@@ -258,11 +258,28 @@ class KernelSVM:
         cap[:n] = cfg.c
         alpha, duals = self._fit_padded(xp, yp, cap)
         keep = alpha[:n] > cfg.tol
+        if not keep.any():
+            # trained but NO support vector survived (degenerate data or a
+            # too-small C/iteration budget): predict() would silently return
+            # all class-1 from f(z) = 0 (ADVICE r4) — surface it
+            import warnings
+
+            warnings.warn(
+                "KernelSVM.fit found no support vectors (all alpha <= "
+                f"tol={cfg.tol}); predictions are vacuous. Increase C or "
+                "iterations, or check the labels.", RuntimeWarning,
+                stacklevel=2)
         self.sv_x = x[keep]
         self.sv_coef = (alpha[:n] * y_signed[:n])[keep]
         return duals
 
     def decision_function(self, z: np.ndarray) -> np.ndarray:
+        if self.sv_x is None:
+            raise ValueError("KernelSVM is not fitted")
+        if len(self.sv_x) == 0:
+            raise ValueError(
+                "KernelSVM has no support vectors (fit warned about this); "
+                "decision_function would be identically 0")
         k = _gram_np(self.config, np.asarray(z, np.float32), self.sv_x) + 1.0
         return k @ self.sv_coef
 

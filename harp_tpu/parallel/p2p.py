@@ -23,10 +23,12 @@ publishes ``harp/p2p/<namespace>/<rank> = host:port`` and peers resolve
 lazily on first send (KV keys are write-once, so each transport generation
 needs its own ``kv_namespace``, agreed across the gang).
 
-Wire format: a per-connection handshake (server sends a 16-byte nonce, the
-client answers HMAC-SHA256(secret, nonce) — no frame is parsed before it
-verifies), then 8-byte big-endian length + pickle of ``(source, payload)``
-frames. Pickle over gang sockets matches the reference's trust model (it
+Wire format: a per-connection handshake (client leads with a 1-byte
+auth-mode marker so a mixed-auth misconfiguration fails fast instead of
+hanging to the connect timeout; the server answers ACK + a 16-byte nonce,
+the client answers HMAC-SHA256(secret, nonce) — no frame is parsed before
+it verifies), then 8-byte big-endian length + pickle of
+``(source, payload)`` frames. Pickle over gang sockets matches the reference's trust model (it
 moved Java-serialized objects over its TCP links, HarpDAALComm.java:339) —
 gang members are mutually trusted — but pickle is code execution, so the
 transport (a) binds the advertised interface only, never 0.0.0.0, and (b)
@@ -59,6 +61,19 @@ _LEN = struct.Struct(">Q")
 _KV_PREFIX = "harp/p2p/"
 _NONCE_LEN = 16
 _MAC_LEN = 32                       # SHA-256 digest size
+# connection-open auth-mode markers (ADVICE r4 — mixed-auth setups must fail
+# fast, not hang to connect_timeout): the client leads with its mode byte,
+# the server replies _MARKER_OK (then the nonce, if authenticated) or
+# _MODE_MISMATCH
+_MODE_PLAIN = b"\x00"
+_MODE_AUTH = b"\x01"
+_MARKER_OK = b"\x06"                # ACK
+_MODE_MISMATCH = b"\x15"            # NAK
+
+
+class P2PAuthModeMismatch(ConnectionError):
+    """Peer runs the opposite auth mode — deterministic config error, not a
+    transient socket failure: never retried."""
 
 
 def _kv_client():
@@ -214,23 +229,37 @@ class P2PTransport:
                              name=f"harp-p2p-reader-{self.rank}").start()
 
     def _challenge(self, conn: socket.socket) -> bool:
-        """Server side of the connection handshake: nonce out, MAC back,
-        one-byte ack out. Returns False (caller closes) on a missing/invalid
-        MAC — no frame from an unauthenticated peer is ever unpickled. The
-        ack is what makes a MISCONFIGURED sender fail loudly: without it the
-        client's first frame lands in its local TCP buffer and send()
-        reports success even though the server dropped the connection."""
-        if self._secret is None:
-            return True
-        nonce = _secrets.token_bytes(_NONCE_LEN)
+        """Server side of the connection handshake. The client leads with a
+        one-byte auth-mode marker (ADVICE r4: without it a mixed-auth
+        misconfiguration hung until connect_timeout — a secret-bearing
+        client blocked on a nonce a no-secret server never sends); a mode
+        mismatch is answered with _MODE_MISMATCH and closed immediately.
+        Mode-matched auth then runs nonce out → MAC back → one-byte ack out.
+        Returns False (caller closes) on a missing/invalid MAC — no frame
+        from an unauthenticated peer is ever unpickled. The ack is what
+        makes a MISCONFIGURED sender fail loudly: without it the client's
+        first frame lands in its local TCP buffer and send() reports success
+        even though the server dropped the connection."""
         conn.settimeout(self._connect_timeout_s)
         try:
-            conn.sendall(nonce)
+            mode = _recv_exact(conn, 1)
+            want = _MODE_AUTH if self._secret is not None else _MODE_PLAIN
+            if mode != want:
+                try:
+                    conn.sendall(_MODE_MISMATCH)
+                except OSError:
+                    pass
+                return False
+            if self._secret is None:
+                conn.sendall(_MARKER_OK)
+                return True
+            nonce = _secrets.token_bytes(_NONCE_LEN)
+            conn.sendall(_MARKER_OK + nonce)
             mac = _recv_exact(conn, _MAC_LEN)
-            want = _hmac.new(self._secret, nonce, "sha256").digest()
-            ok = mac is not None and _hmac.compare_digest(mac, want)
+            want_mac = _hmac.new(self._secret, nonce, "sha256").digest()
+            ok = mac is not None and _hmac.compare_digest(mac, want_mac)
             if ok:
-                conn.sendall(b"\x01")
+                conn.sendall(_MARKER_OK)
             return ok
         except OSError:
             return False
@@ -344,7 +373,22 @@ class P2PTransport:
                 if conn is None:
                     conn = socket.create_connection(
                         self._resolve(dest), timeout=self._connect_timeout_s)
-                    if self._secret is not None:
+                    # lead with the auth-mode byte; a _MODE_MISMATCH reply
+                    # means the peer runs the OPPOSITE auth mode — a
+                    # configuration error that must fail fast and say so
+                    # (ADVICE r4), not hang or drop frames
+                    authed = self._secret is not None
+                    conn.sendall(_MODE_AUTH if authed else _MODE_PLAIN)
+                    marker = _recv_exact(conn, 1)
+                    if marker == _MODE_MISMATCH:
+                        raise P2PAuthModeMismatch(
+                            f"p2p auth-mode mismatch: this transport is "
+                            f"{'authenticated' if authed else 'plain'} but "
+                            f"worker {dest} expects the opposite — check "
+                            f"that every gang member passes the same secret")
+                    if marker != _MARKER_OK:
+                        raise OSError("peer closed during handshake")
+                    if authed:
                         # answer the server's challenge, then REQUIRE its
                         # ack before pooling: a secret mismatch must raise
                         # here, not silently drop buffered frames
@@ -353,7 +397,7 @@ class P2PTransport:
                             raise OSError("peer closed during handshake")
                         conn.sendall(_hmac.new(self._secret, nonce,
                                                "sha256").digest())
-                        if _recv_exact(conn, 1) != b"\x01":
+                        if _recv_exact(conn, 1) != _MARKER_OK:
                             raise OSError(
                                 "p2p handshake rejected — secret mismatch?")
                     # keep the connect timeout as the SEND timeout: sendall
@@ -364,6 +408,8 @@ class P2PTransport:
                         self._conns[dest] = conn
                 conn.sendall(frame)
                 return
+            except P2PAuthModeMismatch:
+                raise                # config error — retrying cannot help
             except OSError as e:
                 last = e
                 with self._lock:

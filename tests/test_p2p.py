@@ -145,6 +145,21 @@ def test_p2p_hmac_handshake_accepts_and_rejects():
             t_bad.send(0, "evil")
         t_bad.close()
 
+        # mixed-auth misconfiguration (ADVICE r4): a PLAIN client against
+        # this authenticated server must fail FAST with the mode-mismatch
+        # error, not hang until connect_timeout waiting on frames/MACs
+        import time as _time
+
+        q_plain = EventQueue()
+        t_plain = P2PTransport(q_plain, rank=3, peers={0: t0.address},
+                               secret=None, retries=1,
+                               connect_timeout_s=30.0)
+        t_start = _time.perf_counter()
+        with pytest.raises(ConnectionError, match="auth-mode mismatch"):
+            t_plain.send(0, "plain-into-auth")
+        assert _time.perf_counter() - t_start < 5.0   # fast, not timeout
+        t_plain.close()
+
         # raw unauthenticated frame: never reaches the queue
         body = pickle.dumps((9, "raw-evil"))
         with sk.create_connection(t0.address, timeout=5.0) as raw:
