@@ -61,16 +61,9 @@ def xeon_lb(vs_cpu: float) -> float:
     return round(vs_cpu / XEON_CORES, 2)
 
 
-def two_point(build, lo: int, hi: int, units_per_iter: float, reps: int = 3
-              ) -> dict:
-    """Two-point device rate: build(n) returns a zero-arg timer that runs ONE
-    already-compiled dispatch with n in-program iterations and blocks until
-    the result is real on host. rate = units/(d wall / d iters); the constant
-    tunnel dispatch+fetch tax cancels in the difference (shared protocol:
-    harp_tpu/benchmark/timing.py)."""
-    from harp_tpu.benchmark.timing import two_point as _tp
-
-    return _tp(build, lo, hi, units_per_iter, reps)
+# shared two-point protocol: rate from the iteration-count delta so the
+# constant tunnel dispatch+fetch tax cancels (harp_tpu/benchmark/timing.py)
+from harp_tpu.benchmark.timing import two_point  # noqa: E402
 
 
 # --------------------------------------------------------------------------- #
@@ -535,7 +528,9 @@ def tpu_attention(l=16384, h=8, dh=64, reps=100):
         np.asarray(fn(q))                    # compile + warm (D2H forces)
 
         def timer():
-            jax.block_until_ready(fn(q))
+            # block_until_ready is async over the tunnel: force with a tiny
+            # D2H fetch (any element of the scan carry needs every rep)
+            np.asarray(fn(q)[0, 0])
         return timer
 
     tp = two_point(build, max(reps // 4, 2), reps, float(l))
@@ -621,8 +616,12 @@ def main():
         "recorded separately as fixed_dispatch_s; spread_pct = (max-min)/"
         "median of the high-count samples")}
 
+    # iteration counts: HIGH enough that each two-point delta carries
+    # >= ~1-2 s of device time — the delta must stand clear of the tunnel's
+    # per-call jitter (timing.py low_resolution note); scan-based epoch
+    # loops make compile time independent of the count
     n, k, d = (100_000, 100, 100) if small else (1_000_000, 100, 100)
-    tpu_iters = 50 if small else 200
+    tpu_iters = 50 if small else 2000
     cpu_iters = 2 if small else 3
 
     km = tpu_kmeans(n, k, d, tpu_iters)
@@ -632,33 +631,33 @@ def main():
     cpu_ips = cpu_kmeans_iters_per_sec(n, k, d, cpu_iters)
     skm_n, skm_d = (16384, 128) if small else (262144, 256)
     skm = tpu_sparse_kmeans(skm_n, k, skm_d, density=0.05,
-                            iters=20 if small else 100)
+                            iters=20 if small else 400)
 
     nu = 4096 if small else 32768
-    sgd_epochs = 20 if small else 100
+    sgd_epochs = 20 if small else 400
     sgd = tpu_sgd_mf(nu, nu, epochs=sgd_epochs)
     sgd_cpu = cpu_sgd_mf_samples_per_sec(nu, nu, epochs=1)
     # rank-128 config: fills the MXU's 128-lane tiles
     sgd128 = tpu_sgd_mf(nu, nu, epochs=sgd_epochs, rank=128)
 
     an = 2048 if small else 8192
-    als = tpu_als(an, an, iters=6 if small else 12)
+    als = tpu_als(an, an, iters=6 if small else 120)
     als_cpu = cpu_als_iters_per_sec(an, an, iters=1)
 
     pn, pd = (32768, 64) if small else (262144, 256)
-    pca = tpu_pca(pn, pd, repeats=50 if small else 100)
+    pca = tpu_pca(pn, pd, repeats=50 if small else 1000)
     pca_cpu = cpu_pca_fits_per_sec(pn, pd, repeats=2)
 
     ld, lv, ll_, lk = (256, 300, 32, 8) if small else (2048, 2000, 128, 32)
-    lda = tpu_lda(ld, lv, ll_, lk, epochs=20 if small else 100)
+    lda = tpu_lda(ld, lv, ll_, lk, epochs=20 if small else 800)
     lda_cpu = cpu_lda_tokens_per_sec(ld // 4, lv, ll_, lk, epochs=1)
     # a clueweb-regime corpus (8x the tokens, 4x the vocab, 2x the topics):
     # per-token fixed costs amortize, so this is the throughput a real LDA
     # workload sees (the small config above is BASELINE's toy shape)
-    lda_big = None if small else tpu_lda(8192, 8000, 256, 64, epochs=30)
+    lda_big = None if small else tpu_lda(8192, 8000, 256, 64, epochs=100)
 
     nn_n, nn_d = (8192, 64) if small else (65536, 128)
-    nn = tpu_nn(nn_n, nn_d, epochs=4 if small else 50)
+    nn = tpu_nn(nn_n, nn_d, epochs=4 if small else 4000)
     nn_cpu = cpu_nn_samples_per_sec(nn_n, nn_d, epochs=1)
     # compute-bound NN config (VERDICT r4 weak #1): bigger batch + hidden
     # sizes — still mini-batch allreduce SGD (NNDaalCollectiveMapper.java:47),
@@ -667,14 +666,14 @@ def main():
     if small:
         nn_big, nn_big_cpu = None, None
     else:
-        nn_big = tpu_nn(65536, 512, epochs=20, layers=(2048, 1024),
+        nn_big = tpu_nn(65536, 512, epochs=30, layers=(2048, 1024),
                         batch_size=8192)
         nn_big_cpu = cpu_nn_samples_per_sec(65536, 512, epochs=1,
                                             layers=(2048, 1024),
                                             batch_size=8192)
 
     attn_l = 2048 if small else 16384
-    attn = tpu_attention(l=attn_l)
+    attn = tpu_attention(l=attn_l, reps=100 if small else 200)
 
     mesh = mesh_scaling_and_collectives()
     try:

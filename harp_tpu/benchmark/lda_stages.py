@@ -84,6 +84,10 @@ def measure(num_docs=2048, vocab=2000, doc_len=128, num_topics=32, epochs=100,
 if __name__ == "__main__":
     kw = {}
     for a in sys.argv[1:]:
-        k, v = a.lstrip("-").split("=")
+        k, _, v = a.lstrip("-").partition("=")
+        if not v:
+            sys.exit(f"usage: lda_stages [key=value ...] with keys "
+                     f"num_docs vocab doc_len num_topics epochs reps "
+                     f"wt_access (got {a!r})")
         kw[k] = v if k == "wt_access" else int(v)
     print(json.dumps(measure(**kw)))

@@ -66,9 +66,12 @@ def measure_chip() -> dict:
     dims = [d, *layers, 16]
     mults = sum(a * b for a, b in zip(dims[:-1], dims[1:]))
     rows = {}
-    for batch in (512, 4096, 65536 // sess.num_workers):
-        eps = _two_point_epoch_s(sess, n, d, layers, batch,
-                                 epochs=48 if batch == 512 else 96)
+    # epochs scale inversely with per-epoch time so every two-point delta
+    # carries >= ~1.5 s of device time (the first cut at 96 epochs resolved
+    # batch4096 to 46 "TFLOPS" — above chip peak, i.e. pure noise)
+    for batch, epochs in ((512, 4000), (4096, 4000),
+                          (65536 // sess.num_workers, 4000)):
+        eps = _two_point_epoch_s(sess, n, d, layers, batch, epochs=epochs)
         steps = -(-(n // sess.num_workers) // batch)
         rows[f"batch{batch}"] = {
             "us_per_step": round(eps / steps * 1e6, 1),
@@ -81,7 +84,7 @@ def measure_chip() -> dict:
     nb, db, lb, bb = 65536, 512, (2048, 1024), 8192
     dimsb = [db, *lb, 16]
     multsb = sum(a * b for a, b in zip(dimsb[:-1], dimsb[1:]))
-    eps = _two_point_epoch_s(sess, nb, db, lb, bb, epochs=16)
+    eps = _two_point_epoch_s(sess, nb, db, lb, bb, epochs=150)
     steps = -(-(nb // sess.num_workers) // bb)
     rows["compute_bound_d512_2048x1024_b8192"] = {
         "us_per_step": round(eps / steps * 1e6, 1),

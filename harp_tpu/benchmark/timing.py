@@ -29,6 +29,9 @@ def two_point_timers(timer_lo: Callable[[], None],
     Each timer runs ONE dispatch and blocks until results are real on host.
     Returns rate (units/s), per_iter_ms, fixed_dispatch_s, spread_pct and the
     raw samples."""
+    if hi <= lo:
+        raise ValueError(f"two-point timing needs hi > lo, got lo={lo} "
+                         f"hi={hi} (pick a larger iteration budget)")
     s_lo, s_hi = [], []
     for _ in range(reps):
         t0 = time.perf_counter()
@@ -38,14 +41,22 @@ def two_point_timers(timer_lo: Callable[[], None],
         timer_hi()
         s_hi.append(time.perf_counter() - t0)
     med_lo, med_hi = statistics.median(s_lo), statistics.median(s_hi)
-    per_iter = (med_hi - med_lo) / (hi - lo)
+    delta = med_hi - med_lo
+    per_iter = delta / (hi - lo)
     if per_iter <= 0:  # noise floor: the workload is all fixed cost
         per_iter = max(med_hi / hi, 1e-9)
+    jitter = max(max(s_hi) - min(s_hi), max(s_lo) - min(s_lo))
     return {
         "rate": units_per_iter / per_iter,
         "per_iter_ms": round(per_iter * 1e3, 4),
         "fixed_dispatch_s": round(max(med_lo - lo * per_iter, 0.0), 3),
         "spread_pct": round(100 * (max(s_hi) - min(s_hi)) / med_hi, 1),
+        "delta_s": round(delta, 4),
+        # the delta must stand clear of the per-sample jitter or the rate is
+        # noise wearing a number (the first NN budget run "measured" 342
+        # TFLOPS — above chip peak — from a 40 ms delta): callers pick
+        # iteration counts so the delta carries seconds of device time
+        "low_resolution": bool(delta < 2 * jitter),
         "iters_lo_hi": [lo, hi],
         "samples_s": {"lo": [round(t, 4) for t in s_lo],
                       "hi": [round(t, 4) for t in s_hi]},

@@ -381,6 +381,10 @@ class P2PTransport:
                     conn.sendall(_MODE_AUTH if authed else _MODE_PLAIN)
                     marker = _recv_exact(conn, 1)
                     if marker == _MODE_MISMATCH:
+                        try:
+                            conn.close()   # never pooled — close before the
+                        except OSError:    # no-retry raise or the fd leaks
+                            pass
                         raise P2PAuthModeMismatch(
                             f"p2p auth-mode mismatch: this transport is "
                             f"{'authenticated' if authed else 'plain'} but "
