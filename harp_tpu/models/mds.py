@@ -53,10 +53,19 @@ def _smacof(d_block, w_block, x0, n: int, cfg: MDSConfig,
     w_rowsum = jnp.sum(w_block, axis=1)              # (rows,) = diag of V
 
     def vmatvec(p_loc, p_full):
-        """Local rows of V @ p: diag term minus the weighted neighbor sum."""
+        """Local rows of V @ p: diag term minus the weighted neighbor sum.
+
+        Precision HIGHEST is load-bearing: the TPU's default f32 matmul
+        truncates operands to bf16, and CG is exactly the algorithm that
+        cannot take it — near convergence pᵀVp lives at noise scale, a
+        truncation sign-flip sends alpha through the 1e-20 guard and the
+        iterate to overflow (measured on the real chip: stress NaN at
+        iteration 1; the CPU-mesh tests never see the default-precision
+        path)."""
         return w_rowsum[:, None] * p_loc - jax.lax.dot_general(
             w_block, p_full, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
 
     def colsum(a):
         return jnp.sum(a, axis=0)                    # per-embedding-column
@@ -97,7 +106,10 @@ def _smacof(d_block, w_block, x0, n: int, cfg: MDSConfig,
 
     def step(x, _):
         my_x = jax.lax.dynamic_slice_in_dim(x, wid * rows, rows, 0)
-        cur = jnp.sqrt(jnp.maximum(dist_ops.pairwise_sq_dist(my_x, x), 1e-12))
+        cur = jnp.sqrt(jnp.maximum(
+            dist_ops.pairwise_sq_dist(my_x, x,
+                                      precision=jax.lax.Precision.HIGHEST),
+            1e-12))
         ratio = jnp.where(cur > 1e-9, d_block / cur, 0.0) * w_block
         # B(X) row block: off-diagonal −ratio, diagonal = row-sum of ratios
         row_sum = jnp.sum(ratio, axis=1)
@@ -105,7 +117,8 @@ def _smacof(d_block, w_block, x0, n: int, cfg: MDSConfig,
         diag_mask = col_ids == (wid * rows + jnp.arange(rows))[:, None]
         bx = -ratio + diag_mask * row_sum[:, None]
         t_loc = jax.lax.dot_general(bx, x, (((1,), (0,)), ((), ())),
-                                    preferred_element_type=jnp.float32)
+                                    preferred_element_type=jnp.float32,
+                                    precision=jax.lax.Precision.HIGHEST)
         # weighted Guttman transform: V X_new = B(X) X, warm-started at the
         # current embedding block (WDAMDSMapper.java:585)
         new_block = cg_solve(t_loc, my_x)

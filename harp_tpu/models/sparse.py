@@ -285,27 +285,31 @@ class CSRCovariance:
         self.session = session
         self._fns = {}
 
-    def _stats(self, rows, cols, vals, num_rows: int, dim: int):
-        sess = self.session
+    def _layout(self, rows, cols, vals, num_rows: int, dim: int):
         cols = np.asarray(cols)
         if cols.size and (cols.min() < 0 or int(cols.max()) >= dim):
             # jit scatters DROP out-of-bounds indices silently — validate
             # here so the contract matches SparseKMeans.prepare
             raise ValueError(f"column ids must be in [0, {dim}); got "
                              f"[{cols.min()}, {cols.max()}]")
-        idx, val, mask, real = csr_worker_layout(rows, cols, vals, num_rows,
-                                                 sess.num_workers)
+        return csr_worker_layout(rows, cols, vals, num_rows,
+                                 self.session.num_workers)
+
+    @staticmethod
+    def _cov_mean(i_, v_, m_, r_, dim):
+        gram, s, n = sparse_gram_stats(i_, v_, m_, r_, dim)
+        mean = s / jnp.maximum(n, 1.0)
+        cov = (gram - n * jnp.outer(mean, mean)) / jnp.maximum(n - 1.0, 1.0)
+        return cov, mean
+
+    def _stats(self, rows, cols, vals, num_rows: int, dim: int):
+        sess = self.session
+        idx, val, mask, real = self._layout(rows, cols, vals, num_rows, dim)
         key = (idx.shape, dim)
         if key not in self._fns:
-            def fn(i_, v_, m_, r_):
-                gram, s, n = sparse_gram_stats(i_, v_, m_, r_, dim)
-                mean = s / jnp.maximum(n, 1.0)
-                cov = (gram - n * jnp.outer(mean, mean)) / jnp.maximum(
-                    n - 1.0, 1.0)
-                return cov, mean
-
             self._fns[key] = sess.spmd(
-                fn, in_specs=(sess.shard(),) * 4,
+                lambda i_, v_, m_, r_: self._cov_mean(i_, v_, m_, r_, dim),
+                in_specs=(sess.shard(),) * 4,
                 out_specs=(sess.replicate(), sess.replicate()))
         return self._fns[key](sess.scatter(idx), sess.scatter(val),
                               sess.scatter(mask), sess.scatter(real))
@@ -313,6 +317,33 @@ class CSRCovariance:
     def compute(self, rows, cols, vals, num_rows: int, dim: int
                 ) -> Tuple[np.ndarray, np.ndarray]:
         cov, mean = self._stats(rows, cols, vals, num_rows, dim)
+        return np.asarray(cov), np.asarray(mean)
+
+    def compute_repeated(self, rows, cols, vals, num_rows: int, dim: int,
+                         repeats: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Run ``repeats`` full covariance passes inside ONE compiled program
+        (carry-dependent scan, same idiom as stats.PCA.fit_repeated) — the
+        bench measures device work, not per-dispatch tunnel cost."""
+        sess = self.session
+        idx, val, mask, real = self._layout(rows, cols, vals, num_rows, dim)
+        key = (idx.shape, dim, repeats, "rep")
+        if key not in self._fns:
+            def fn(i_, v_, m_, r_):
+                def body(carry, _):
+                    eps = carry[0]
+                    cov, mean = self._cov_mean(i_, v_ + eps, m_, r_, dim)
+                    return (cov[0, 0] * 1e-30, cov, mean), None
+                init = (jnp.float32(0.0), jnp.zeros((dim, dim)),
+                        jnp.zeros((dim,)))
+                (_, cov, mean), _ = jax.lax.scan(body, init, None,
+                                                 length=repeats)
+                return cov, mean
+
+            self._fns[key] = sess.spmd(
+                fn, in_specs=(sess.shard(),) * 4,
+                out_specs=(sess.replicate(), sess.replicate()))
+        cov, mean = self._fns[key](sess.scatter(idx), sess.scatter(val),
+                                   sess.scatter(mask), sess.scatter(real))
         return np.asarray(cov), np.asarray(mean)
 
 

@@ -26,13 +26,16 @@ import jax.numpy as jnp
 
 
 def pairwise_sq_dist(x: jax.Array, c: jax.Array,
-                     compute_dtype=None) -> jax.Array:
+                     compute_dtype=None, precision=None) -> jax.Array:
     """Squared Euclidean distances (N, K) between rows of x (N, D) and c (K, D).
 
     ``compute_dtype=jnp.bfloat16`` runs the cross-term matmul in bf16 with f32
     accumulation — the MXU-native recipe; the squared-norm terms stay f32 so
     only the (well-conditioned) cross term loses mantissa. On v5e this halves
-    the dominant (N, K) HBM traffic.
+    the dominant (N, K) HBM traffic. ``precision=jax.lax.Precision.HIGHEST``
+    keeps true-f32 cross terms on TPU (whose DEFAULT f32 matmul truncates to
+    bf16) — needed when downstream math is precision-sensitive (MDS SMACOF),
+    irrelevant for argmin-only uses (K-means).
     """
     xf = x.astype(jnp.float32)
     cf = c.astype(jnp.float32)
@@ -42,7 +45,8 @@ def pairwise_sq_dist(x: jax.Array, c: jax.Array,
     cm = c if compute_dtype is None else c.astype(compute_dtype)
     xc = jax.lax.dot_general(
         xm, cm, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)               # (N, K)
+        preferred_element_type=jnp.float32,
+        precision=precision)                              # (N, K)
     return x2 - 2.0 * xc + c2
 
 

@@ -115,11 +115,21 @@ class Checkpointer:
             self._ckptr.save(path, state, force=True)
         else:
             # numpy fallback stores leaves only; restore() needs `like` to
-            # rebuild the tree structure
-            os.makedirs(path, exist_ok=True)
+            # rebuild the tree structure. Write into a tmp dir and rename:
+            # a fail-stop kill mid-write (elastic gang restart, r5) must
+            # never leave a step dir that lists as restorable but holds a
+            # torn npz — _list_steps only matches the final name, so a
+            # checkpoint EXISTS iff it is complete
+            tmp = f"{path}.tmp-{os.getpid()}"
+            os.makedirs(tmp, exist_ok=True)
             leaves, _ = jax.tree.flatten(state)
-            np.savez(os.path.join(path, "arrays.npz"),
+            np.savez(os.path.join(tmp, "arrays.npz"),
                      **{str(i): leaf for i, leaf in enumerate(leaves)})
+            if os.path.isdir(path):      # re-save of the same step
+                import shutil
+
+                shutil.rmtree(path)
+            os.replace(tmp, path)
         self._prune()
 
     def restore(self, step: int, like: Optional[Any] = None) -> Any:
@@ -162,3 +172,8 @@ class Checkpointer:
         steps = self._list_steps()
         for s in steps[: max(0, len(steps) - self.keep)]:
             shutil.rmtree(self._step_dir(s), ignore_errors=True)
+        for name in os.listdir(self.directory):
+            # stale tmp dirs from a writer killed mid-write (fail-stop)
+            if ".tmp-" in name and not name.endswith(f"tmp-{os.getpid()}"):
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)

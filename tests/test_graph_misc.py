@@ -81,6 +81,30 @@ def test_wda_mds_weighted_cg_matches_numpy_oracle(session):
     assert np.abs(d_emb - d).mean() < 0.15 * d.mean()
 
 
+def test_mds_matmuls_request_highest_precision(session):
+    """Regression guard for a REAL-CHIP-only failure the CPU suite cannot
+    reproduce: TPU's default f32 matmul truncates operands to bf16, which
+    sign-flips the CG's pᵀVp at convergence scale and sent the embedding to
+    overflow (stress NaN at iteration 1 on hardware, round 5). The three
+    SMACOF matmuls (V matvec, B(X)·X, pairwise distances) must pin
+    Precision.HIGHEST — assert it survives in the traced jaxpr."""
+    from harp_tpu.models.mds import MDSConfig, _smacof
+
+    n = 16
+    cfg = MDSConfig(dim=2, iterations=1)
+    prog = session.spmd(
+        lambda d, wt, x0: _smacof(d, wt, x0, n, cfg),
+        in_specs=(session.shard(), session.shard(), session.replicate()),
+        out_specs=(session.replicate(), session.replicate()))
+    text = prog.lower(np.zeros((n, n), np.float32),
+                      np.zeros((n, n), np.float32),
+                      np.zeros((n, 2), np.float32)).as_text()
+    dots = [ln for ln in text.splitlines() if "dot_general" in ln]
+    assert dots, "no dot_general in the SMACOF program?"
+    low = [ln for ln in dots if "HIGHEST" not in ln]
+    assert not low, f"SMACOF matmuls without HIGHEST precision: {low}"
+
+
 def test_em_gmm_recovers_components(session):
     rng = np.random.default_rng(9)
     centers = np.array([[0, 0], [6, 0], [0, 6]], np.float32)
