@@ -49,6 +49,13 @@ def _fsspec_open(path: str, mode: str = "rb"):
     return _fsspec(path).open(path, mode)
 
 
+def _hidden(path: str) -> bool:
+    """Hadoop input-format convention: basenames starting with ``_`` or
+    ``.`` are metadata (``_SUCCESS``, ``_README``, ``.crc``), not data."""
+    base = os.path.basename(path.rstrip("/"))
+    return base.startswith(("_", "."))
+
+
 def list_files(spec: str) -> List[str]:
     """Expand a path/glob/directory into concrete file paths, local or remote.
 
@@ -58,6 +65,8 @@ def list_files(spec: str) -> List[str]:
     """
     if _is_url(spec):
         fs, path = _fsspec(spec).core.url_to_fs(spec)
+        if not fs.isdir(path) and fs.exists(path):
+            return [spec]        # an explicitly named file is never hidden
         if fs.isdir(path):
             # detail=True: one listing RPC, not one isdir stat per entry
             entries = fs.ls(path, detail=True)
@@ -73,14 +82,18 @@ def list_files(spec: str) -> List[str]:
             else:
                 entries = [fs.info(n) for n in (got if got is not None
                                                 else fs.glob(path))]
-        names = [e["name"] for e in entries if e.get("type") != "directory"]
+        names = [e["name"] for e in entries if e.get("type") != "directory"
+                 and not _hidden(e["name"])]
         return sorted(fs.unstrip_protocol(n) for n in names)
     import glob as _glob
 
+    if os.path.isfile(spec):
+        return [spec]            # an explicitly named file is never hidden
     if os.path.isdir(spec):
         return sorted(os.path.join(spec, n) for n in os.listdir(spec)
-                      if os.path.isfile(os.path.join(spec, n)))
-    return sorted(_glob.glob(spec))
+                      if os.path.isfile(os.path.join(spec, n))
+                      and not _hidden(n))
+    return sorted(p for p in _glob.glob(spec) if not _hidden(p))
 
 
 def split_files(paths: Sequence[str], num_workers: int) -> List[List[str]]:
@@ -228,3 +241,36 @@ def regroup_coo_by_row(rows, cols, vals, num_workers: int):
         m = owner == w
         out.append((rows[m], cols[m], vals[m]))
     return out
+
+
+def load_corpus(spec: str) -> np.ndarray:
+    """Rectangular token-id corpus: one document per line, space-separated
+    integer token ids, every line the SAME length (the fixture/bench format
+    — LDA's blocked layout takes a dense (D, L) token matrix; see
+    datasets/lda/). ``spec`` may be a file, directory, or glob, local or
+    remote (list_files)."""
+    parts = []
+    for path in list_files(spec):
+        if _is_url(path):
+            with _fsspec_open(path) as f:
+                parts.append(np.loadtxt(f, dtype=np.int64, ndmin=2))
+        else:
+            parts.append(np.loadtxt(path, dtype=np.int64, ndmin=2))
+    if not parts:
+        raise FileNotFoundError(f"no corpus files match {spec!r}")
+    widths = {p.shape[1] for p in parts}
+    if len(widths) > 1:
+        raise ValueError(
+            f"corpus files disagree on document length: {sorted(widths)} "
+            f"(the dense token-matrix format needs one fixed length)")
+    return np.concatenate(parts, axis=0)
+
+
+def load_labeled_csv(spec: str, num_threads: int = 4
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """Dense CSV with the LABEL in the last column (the daal_svm/daal_naive
+    fixture format): returns (x (N, D) f32, y (N,) int32)."""
+    m = load_dense_csv(list_files(spec), num_threads=num_threads)
+    if m.shape[1] < 2:
+        raise ValueError("labeled CSV needs >= 2 columns (features, label)")
+    return m[:, :-1], m[:, -1].astype(np.int32)

@@ -332,8 +332,13 @@ class KernelSVM:
         the dispatch count is ceil(P/chunk), not P."""
         sess, cfg = self.session, self.config
         p, n_pad, d = xp.shape
-        budget = 256 * 1024 * 1024          # bytes for the 3 pair operands
-        chunk = max(1, min(p, budget // max(n_pad * (d + 2) * 4, 1)))
+        budget = 256 * 1024 * 1024
+        # per-pair bytes: the 3 operands PLUS the ring-hop transients — each
+        # vmapped pair materializes an (n_pad/W, n_pad/W) Gram block and ~3
+        # same-size kernel temporaries (d², exp, matvec) per hop
+        n_loc = -(-n_pad // max(sess.num_workers, 1))
+        per_pair = (n_pad * (d + 2) + 4 * n_loc * n_loc) * 4
+        chunk = max(1, min(p, budget // max(per_pair, 1)))
         key = ("pairs", chunk, n_pad, d)
         if key not in self._fns:
             self._fns[key] = sess.spmd(

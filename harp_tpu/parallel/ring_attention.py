@@ -168,7 +168,8 @@ def blocked_attention(qf: jax.Array, kf: jax.Array, vf: jax.Array,
     # r4). Opt out with HARP_FLASH_PALLAS=0.
     from harp_tpu.ops import pallas_kernels as _pk
 
-    if dv == dh and _pk.use_flash_pallas(l_full):
+    if _pk.use_flash_pallas(l_full):
+        # any L and Dv != Dh: the kernel pads + masks internally (r5)
         return _pk.flash_attention_pallas(qf, kf, vf, causal)
     b = min(kv_block, l_full)
     # pad the KV axis up to a block multiple (padded keys masked by

@@ -17,8 +17,14 @@ flags derived from the model's config dataclass (harp_tpu.config):
 Every subcommand accepts ``--num-workers N`` (mesh size; defaults to all
 devices) and ``--cpu-mesh`` (force an N-device virtual CPU mesh — the
 reference's multi-mapper local mode). Data is synthetic by default
-(io.datagen — the reference launchers likewise embedded generators); kmeans
-accepts ``--points-file`` for CSV input.
+(io.datagen — the reference launchers likewise embedded generators); file
+input mirrors the reference's per-algorithm datasets/ (tiny canonical
+fixtures ship in ``datasets/``, regenerate with ``datasets/generate.py``):
+``kmeans``/``pca`` ``--points-file``, ``svm`` ``--train-file`` (label in
+the last column), ``sgd_mf``/``als`` ``--ratings-file`` (COO), ``lda``
+``--corpus-file``, ``subgraph`` ``--template-file`` — each takes a file,
+a directory of part-files, or a glob, local or ``scheme://`` remote
+(io.loaders.list_files).
 """
 
 from __future__ import annotations
@@ -151,7 +157,9 @@ def run_kmeans(argv) -> int:
               f"{costs[0]:.1f} -> {costs[-1]:.1f}")
         return 0
     if args.points_file:
-        pts = loaders.load_dense_csv([args.points_file])
+        # file, directory of part-files, or glob — local or scheme:// remote
+        pts = loaders.load_dense_csv(loaders.list_files(args.points_file))
+        cfg = dataclasses.replace(cfg, dim=pts.shape[1])
     else:
         pts = datagen.dense_points(args.num_points, cfg.dim, seed=args.seed,
                                    num_clusters=cfg.num_centroids)
@@ -204,6 +212,9 @@ def run_sgd_mf(argv) -> int:
     p.add_argument("--num-users", type=int, default=8192)
     p.add_argument("--num-items", type=int, default=8192)
     p.add_argument("--density", type=float, default=0.01)
+    p.add_argument("--ratings-file", default="",
+                   help="COO 'row col value' file/dir/glob (e.g. "
+                        "datasets/sgd_mf); overrides the synthetic data")
     p.add_argument("--adaptive", action="store_true",
                    help="auto-tune the per-hop budget (adjustMiniBatch analog)")
     p.add_argument("--save-every", type=int, default=0,
@@ -222,12 +233,20 @@ def run_sgd_mf(argv) -> int:
     from harp_tpu.models import sgd_mf
 
     cfg = _config_from_args(sgd_mf.SGDMFConfig, args)
-    rows, cols, vals = datagen.sparse_ratings(
-        args.num_users, args.num_items, rank=min(cfg.rank, 16),
-        density=args.density, seed=args.seed)
+    if args.ratings_file:
+        from harp_tpu.io import loaders
+
+        rows, cols, vals = loaders.load_coo(
+            loaders.list_files(args.ratings_file))
+        # shapes come from the data; --num-users/--num-items are ignored
+        nu, ni = int(rows.max()) + 1, int(cols.max()) + 1
+    else:
+        rows, cols, vals = datagen.sparse_ratings(
+            args.num_users, args.num_items, rank=min(cfg.rank, 16),
+            density=args.density, seed=args.seed)
+        nu, ni = args.num_users, args.num_items
     model = sgd_mf.SGDMF(sess, cfg)
-    state = model.prepare(rows, cols, vals, args.num_users, args.num_items,
-                          seed=args.seed)
+    state = model.prepare(rows, cols, vals, nu, ni, seed=args.seed)
     t0 = time.perf_counter()
     if args.save_every:
         from harp_tpu.utils.checkpoint import Checkpointer
@@ -276,6 +295,10 @@ def run_lda(argv) -> int:
     _common_flags(p)
     p.add_argument("--num-docs", type=int, default=1024)
     p.add_argument("--doc-len", type=int, default=64)
+    p.add_argument("--corpus-file", default="",
+                   help="token-id corpus file/dir/glob (one doc per line, "
+                        "fixed length — e.g. datasets/lda); overrides the "
+                        "synthetic corpus; vocab grows to fit the data")
     p.add_argument("--save-every", type=int, default=0,
                    help="checkpoint the chain (z + word-topic model) every "
                         "N epochs into work-dir (printModel parity; resumes "
@@ -292,10 +315,19 @@ def run_lda(argv) -> int:
     from harp_tpu.models import lda
 
     cfg = _config_from_args(lda.LDAConfig, args)
-    num_docs = args.num_docs - args.num_docs % sess.num_workers
-    docs = datagen.lda_corpus(num_docs, cfg.vocab,
-                              max(2, cfg.num_topics // 2), args.doc_len,
-                              seed=args.seed)
+    if args.corpus_file:
+        from harp_tpu.io import loaders
+
+        docs = loaders.load_corpus(args.corpus_file)
+        docs = docs[: len(docs) - len(docs) % sess.num_workers]
+        num_docs = len(docs)
+        if docs.size and int(docs.max()) >= cfg.vocab:
+            cfg = dataclasses.replace(cfg, vocab=int(docs.max()) + 1)
+    else:
+        num_docs = args.num_docs - args.num_docs % sess.num_workers
+        docs = datagen.lda_corpus(num_docs, cfg.vocab,
+                                  max(2, cfg.num_topics // 2), args.doc_len,
+                                  seed=args.seed)
     model = lda.LDA(sess, cfg)
     state = model.prepare(docs, seed=args.seed)   # host layout + H2D once
     if args.save_every:
@@ -340,7 +372,12 @@ def run_pca(argv) -> int:
                    help="csr = daal_pca/corcsrdistr from sparse input")
     p.add_argument("--density", type=float, default=0.05,
                    help="synthetic sparsity for --format csr")
+    p.add_argument("--points-file", default="",
+                   help="dense CSV file/dir/glob (e.g. datasets/pca); "
+                        "overrides the synthetic data (dense format only)")
     args = p.parse_args(argv)
+    if args.points_file and args.format == "csr":
+        p.error("--points-file applies to --format dense only")
     sess = _session(args)
     import numpy as np
 
@@ -364,7 +401,14 @@ def run_pca(argv) -> int:
               f"nnz={len(vals)}: fit in {dt:.2f}s (incl compile), top "
               f"eigenvalue {w[0]:.4f}")
         return 0
-    x = datagen.dense_points(n, args.dim, seed=args.seed)
+    if args.points_file:
+        from harp_tpu.io import loaders
+
+        x = loaders.load_dense_csv(loaders.list_files(args.points_file))
+        x = x[: len(x) - len(x) % sess.num_workers]
+        n = len(x)
+    else:
+        x = datagen.dense_points(n, args.dim, seed=args.seed)
     # place once; re-scattering an already-placed array is a no-op, and the
     # repeats loop runs INSIDE one compiled program (stats.PCA.fit_repeated)
     # so the timing is compute, not transfers or per-call dispatch
@@ -377,14 +421,14 @@ def run_pca(argv) -> int:
         t0 = time.perf_counter()
         w, comps, mean = model.fit(x_dev)
         dt = time.perf_counter() - t0
-        print(f"pca[svd] workers={sess.num_workers} n={n} d={args.dim}: "
+        print(f"pca[svd] workers={sess.num_workers} n={n} d={x.shape[1]}: "
               f"{1.0 / dt:.2f} fits/s, top eigenvalue {w[0]:.4f}")
         return 0
     model.fit_repeated(x_dev, args.iterations)    # compile + warmup
     t0 = time.perf_counter()
     w, comps, mean = model.fit_repeated(x_dev, args.iterations)
     dt = time.perf_counter() - t0
-    print(f"pca workers={sess.num_workers} n={n} d={args.dim}: "
+    print(f"pca workers={sess.num_workers} n={n} d={x.shape[1]}: "
           f"{args.iterations / dt:.2f} fits/s, top eigenvalue {w[0]:.4f}")
     return 0
 
@@ -430,6 +474,9 @@ def run_als(argv) -> int:
     p.add_argument("--num-users", type=int, default=2048)
     p.add_argument("--num-items", type=int, default=2048)
     p.add_argument("--density", type=float, default=0.01)
+    p.add_argument("--ratings-file", default="",
+                   help="COO 'row col value' file/dir/glob (e.g. "
+                        "datasets/als); overrides the synthetic data")
     _add_config_flags(p, ALSConfig)
     args = p.parse_args(argv)
     sess = _session(args)
@@ -437,16 +484,24 @@ def run_als(argv) -> int:
     from harp_tpu.models import als
 
     cfg = _config_from_args(als.ALSConfig, args)
-    rows, cols, vals = datagen.sparse_ratings(
-        args.num_users, args.num_items, rank=min(cfg.rank, 16),
-        density=args.density, seed=args.seed)
+    if args.ratings_file:
+        from harp_tpu.io import loaders
+
+        rows, cols, vals = loaders.load_coo(
+            loaders.list_files(args.ratings_file))
+        # shapes come from the data; --num-users/--num-items are ignored
+        nu, ni = int(rows.max()) + 1, int(cols.max()) + 1
+    else:
+        rows, cols, vals = datagen.sparse_ratings(
+            args.num_users, args.num_items, rank=min(cfg.rank, 16),
+            density=args.density, seed=args.seed)
+        nu, ni = args.num_users, args.num_items
     if cfg.implicit:
         import numpy as np
 
         vals = np.abs(vals)      # implicit mode consumes interaction counts
     model = als.ALS(sess, cfg)
-    state = model.prepare(rows, cols, vals, args.num_users, args.num_items,
-                          seed=args.seed)
+    state = model.prepare(rows, cols, vals, nu, ni, seed=args.seed)
     model.train_prepared(state)                   # compile + warmup
     t0 = time.perf_counter()
     u, v, rmse = model.fit_prepared(state)
@@ -617,14 +672,28 @@ def run_svm(argv) -> int:
                         "dataclass defaults)")
     p.add_argument("--lr", type=float, default=0.1,
                    help="primal (linear) path only")
+    p.add_argument("--train-file", default="",
+                   help="labeled dense CSV file/dir/glob, label in the LAST "
+                        "column (e.g. datasets/svm); overrides synthetic")
     args = p.parse_args(argv)
     sess = _session(args)
     from harp_tpu.io import datagen
     from harp_tpu.models import svm
 
-    n = args.num_points - args.num_points % sess.num_workers
-    k = max(2, args.num_classes)
-    x, y = datagen.classification_data(n, args.dim, k, seed=args.seed)
+    if args.train_file:
+        from harp_tpu.io import loaders
+
+        x, y = loaders.load_labeled_csv(args.train_file)
+        n = len(x) - len(x) % sess.num_workers
+        x, y = x[:n], y[:n]
+        import numpy as np
+
+        k = max(2, len(np.unique(y)))
+    else:
+        n = args.num_points - args.num_points % sess.num_workers
+        k = max(2, args.num_classes)
+        x, y = datagen.classification_data(n, args.dim, k, seed=args.seed)
+    dim = x.shape[1]
     t0 = time.perf_counter()
     if args.kernel == "linear" and k == 2:
         cfg = svm.SVMConfig(c=args.c, lr=args.lr,
@@ -634,7 +703,7 @@ def run_svm(argv) -> int:
         dt = time.perf_counter() - t0
         acc = (model.predict(x) == y).mean()
         print(f"svm[linear-primal] workers={sess.num_workers} n={n} "
-              f"d={args.dim}: {cfg.iterations / dt:.1f} iters/s (incl "
+              f"d={dim}: {cfg.iterations / dt:.1f} iters/s (incl "
               f"compile), hinge {losses[0]:.4f} -> {losses[-1]:.4f}, "
               f"train acc {acc:.3f}")
         return 0
@@ -645,7 +714,7 @@ def run_svm(argv) -> int:
         dt = time.perf_counter() - t0
         acc = (model.predict(x) == y).mean()
         print(f"svm[{args.kernel}-dual] workers={sess.num_workers} n={n} "
-              f"d={args.dim}: {kcfg.iterations / dt:.1f} iters/s (incl "
+              f"d={dim}: {kcfg.iterations / dt:.1f} iters/s (incl "
               f"compile), dual {duals[0]:.2f} -> {duals[-1]:.2f}, "
               f"{len(model.sv_x)} SVs, train acc {acc:.3f}")
     else:
@@ -653,7 +722,7 @@ def run_svm(argv) -> int:
         dt = time.perf_counter() - t0
         acc = (model.predict(x) == y).mean()
         print(f"svm[{args.kernel}-ovo] workers={sess.num_workers} n={n} "
-              f"d={args.dim} classes={k}: {len(model._machines)} machines "
+              f"d={dim} classes={k}: {len(model._machines)} machines "
               f"in {dt:.1f}s, train acc {acc:.3f}")
     return 0
 

@@ -94,3 +94,36 @@ def test_kmeans_dense_csv_blocks_to_fit(session, tmp_path):
     _, costs = model.fit(loaded, cen0)
     costs = np.asarray(costs)
     assert costs[-1] < costs[0]
+
+
+def test_shipped_fixture_datasets_load(session):
+    """The committed datasets/ fixtures (reference parity:
+    /root/reference/datasets ships per-algorithm canonical inputs) load
+    through the same file flags the CLI exposes, and metadata files
+    (_README — the Hadoop hidden-file convention) are skipped."""
+    import harp_tpu
+
+    root = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(harp_tpu.__file__))), "datasets")
+    files = loaders.list_files(os.path.join(root, "kmeans"))
+    assert len(files) == 4 and all("part-" in f for f in files)
+
+    pts = loaders.load_dense_csv(files)
+    assert pts.shape == (512, 16)
+
+    docs = loaders.load_corpus(os.path.join(root, "lda"))
+    assert docs.shape == (128, 32) and docs.min() >= 0
+
+    x, y = loaders.load_labeled_csv(os.path.join(root, "svm"))
+    assert x.shape == (256, 8) and set(np.unique(y)) == {0, 1}
+
+    rows, cols, vals = loaders.load_coo(
+        loaders.list_files(os.path.join(root, "sgd_mf")))
+    assert len(rows) == len(cols) == len(vals) > 1000
+
+    # a fixture-driven fit end to end (the CLI's --points-file path)
+    cen0 = datagen.initial_centroids(pts, 8, seed=1)
+    model = km.KMeans(session, km.KMeansConfig(8, 16, iterations=8))
+    _, costs = model.fit(pts, cen0)
+    costs = np.asarray(costs)
+    assert costs[-1] < costs[0]
