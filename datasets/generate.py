@@ -9,9 +9,9 @@ CLI's file flags consume), and matches the format its subcommand expects::
 
     python datasets/generate.py          # rewrites datasets/* in place
 
-Consumed by: ``harp_tpu.run {kmeans,pca,svm,naive} --points-file/--train-file``,
-``{sgd_mf,als} --ratings-file``, ``lda --corpus-file``,
-``subgraph --template-file``, examples/analytics_tour.py, and the
+Consumed by: ``harp_tpu.run {kmeans,pca} --points-file``,
+``svm --train-file``, ``{sgd_mf,als} --ratings-file``,
+``lda --corpus-file``, ``subgraph --template-file``, and the
 kmeans_from_files bench row.
 """
 

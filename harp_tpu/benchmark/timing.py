@@ -45,7 +45,15 @@ def two_point_timers(timer_lo: Callable[[], None],
     per_iter = delta / (hi - lo)
     if per_iter <= 0:  # noise floor: the workload is all fixed cost
         per_iter = max(med_hi / hi, 1e-9)
-    jitter = max(max(s_hi) - min(s_hi), max(s_lo) - min(s_lo))
+
+    def _jitter(s):
+        # spread of the two FASTEST runs: bounds steady-state noise without
+        # letting one slow outlier (a tunnel hiccup / cold first call)
+        # declare a cleanly-resolved row unresolved
+        a = sorted(s)
+        return a[1] - a[0] if len(a) > 1 else 0.0
+
+    jitter = max(_jitter(s_hi), _jitter(s_lo))
     return {
         "rate": units_per_iter / per_iter,
         "per_iter_ms": round(per_iter * 1e3, 4),

@@ -65,11 +65,11 @@ def list_files(spec: str) -> List[str]:
     """
     if _is_url(spec):
         fs, path = _fsspec(spec).core.url_to_fs(spec)
-        if not fs.isdir(path) and fs.exists(path):
-            return [spec]        # an explicitly named file is never hidden
         if fs.isdir(path):
             # detail=True: one listing RPC, not one isdir stat per entry
             entries = fs.ls(path, detail=True)
+        elif "*" not in path and "?" not in path and fs.exists(path):
+            return [spec]        # an explicitly named file is never hidden
         else:
             # fs.glob(detail=True) only exists on recent fsspec (ADVICE r4);
             # plain glob + per-entry info keeps older releases working
@@ -121,6 +121,17 @@ def load_dense_csv_one(path: str, sep: str = ",") -> np.ndarray:
     return np.loadtxt(path, delimiter=sep, dtype=np.float32, ndmin=2)
 
 
+def truncate_to_workers(arr: np.ndarray, num_workers: int) -> np.ndarray:
+    """Trim leading-axis length to a worker multiple (the load-then-shard
+    idiom every file-input CLI path uses)."""
+    n = len(arr) - len(arr) % num_workers
+    if n == 0:
+        raise ValueError(
+            f"{len(arr)} rows cannot shard over {num_workers} workers "
+            f"(need at least one row per worker)")
+    return arr[:n]
+
+
 def load_dense_csv(paths: Sequence[str], num_threads: int = 4,
                    sep: str = ",") -> np.ndarray:
     """Multithreaded dense CSV load (HarpDAALDataSource.createDenseNumericTable:76).
@@ -128,6 +139,10 @@ def load_dense_csv(paths: Sequence[str], num_threads: int = 4,
     Returns the row-concatenation of all files, in path order.
     """
     paths = list(paths)
+    if not paths:
+        raise FileNotFoundError(
+            "load_dense_csv: no input files (empty path list — check the "
+            "path/glob; note _/.-prefixed basenames are skipped as hidden)")
     results: List[Optional[np.ndarray]] = [None] * len(paths)
 
     class _ReadTask(Task[Tuple[int, str], Tuple[int, np.ndarray]]):
@@ -170,6 +185,10 @@ def load_coo(paths: Sequence[str], sep: str = " ", num_threads: int = 4
     order. Files are read by the MTReader-equivalent thread pool — ctypes
     releases the GIL, so the native per-file parsers genuinely overlap."""
     paths = list(paths)
+    if not paths:
+        raise FileNotFoundError(
+            "load_coo: no input files (empty path list — check the "
+            "path/glob; note _/.-prefixed basenames are skipped as hidden)")
     results: List[Optional[Tuple]] = [None] * len(paths)
 
     class _ReadCOOTask(Task[Tuple[int, str], Tuple[int, Tuple]]):

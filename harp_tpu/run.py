@@ -160,10 +160,11 @@ def run_kmeans(argv) -> int:
         # file, directory of part-files, or glob — local or scheme:// remote
         pts = loaders.load_dense_csv(loaders.list_files(args.points_file))
         cfg = dataclasses.replace(cfg, dim=pts.shape[1])
+        pts = loaders.truncate_to_workers(pts, sess.num_workers)
     else:
         pts = datagen.dense_points(args.num_points, cfg.dim, seed=args.seed,
                                    num_clusters=cfg.num_centroids)
-    pts = pts[: len(pts) - len(pts) % sess.num_workers]
+        pts = pts[: len(pts) - len(pts) % sess.num_workers]
     cen0 = datagen.initial_centroids(pts, cfg.num_centroids, seed=args.seed + 1)
     model = km.KMeans(sess, cfg)
     pts_dev, cen_dev = model.prepare(pts, cen0)
@@ -318,8 +319,8 @@ def run_lda(argv) -> int:
     if args.corpus_file:
         from harp_tpu.io import loaders
 
-        docs = loaders.load_corpus(args.corpus_file)
-        docs = docs[: len(docs) - len(docs) % sess.num_workers]
+        docs = loaders.truncate_to_workers(loaders.load_corpus(
+            args.corpus_file), sess.num_workers)
         num_docs = len(docs)
         if docs.size and int(docs.max()) >= cfg.vocab:
             cfg = dataclasses.replace(cfg, vocab=int(docs.max()) + 1)
@@ -404,8 +405,9 @@ def run_pca(argv) -> int:
     if args.points_file:
         from harp_tpu.io import loaders
 
-        x = loaders.load_dense_csv(loaders.list_files(args.points_file))
-        x = x[: len(x) - len(x) % sess.num_workers]
+        x = loaders.truncate_to_workers(
+            loaders.load_dense_csv(loaders.list_files(args.points_file)),
+            sess.num_workers)
         n = len(x)
     else:
         x = datagen.dense_points(n, args.dim, seed=args.seed)
@@ -681,14 +683,18 @@ def run_svm(argv) -> int:
     from harp_tpu.models import svm
 
     if args.train_file:
-        from harp_tpu.io import loaders
-
-        x, y = loaders.load_labeled_csv(args.train_file)
-        n = len(x) - len(x) % sess.num_workers
-        x, y = x[:n], y[:n]
         import numpy as np
 
-        k = max(2, len(np.unique(y)))
+        from harp_tpu.io import loaders
+
+        x, y_raw = loaders.load_labeled_csv(args.train_file)
+        x = loaders.truncate_to_workers(x, sess.num_workers)
+        n = len(x)
+        # the trainers take labels 0..k-1 (mapped internally to ±1); CSV
+        # labels may use any convention (±1, 1..k) — remap via unique
+        classes, y = np.unique(y_raw[:n], return_inverse=True)
+        y = y.astype(np.int32)
+        k = max(2, len(classes))
     else:
         n = args.num_points - args.num_points % sess.num_workers
         k = max(2, args.num_classes)
