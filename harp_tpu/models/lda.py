@@ -72,12 +72,20 @@ class LDAConfig:
     epochs: int = 20
     method: str = "cgs"         # "cgs" (ml/java lda) or "cvb0" (contrib/lda)
     balance: bool = True        # serpentine-LPT word→block assignment
-    wt_access: str = "auto"     # auto | gemm | gather — how tokens read/write
-    #   the word-topic block. "gemm" replaces the per-token row gather and
-    #   segment-sum scatter (row-granularity-bound on TPU, ~4M tokens/s) with
-    #   one-hot matmuls on the MXU (f32 one-hot: counts are integers beyond
-    #   bf16's 8-bit mantissa); costs FLOPs ∝ vocab-block width, so "auto"
-    #   picks gemm only for blocks <= 8192 wide
+    wt_access: str = "auto"     # auto | gemm_scatter | gemm | gather — how
+    #   tokens read/write the word-topic block.
+    #   * "gather": row-gather read + segment_sum write (the r≤4 default).
+    #     The r5 stage budget showed the segment_sum is 82% of the hop
+    #     (2.25 of 2.73 ms/epoch on the bench config — XLA scatter
+    #     serializes at ~8.5 ns/row).
+    #   * "gemm_scatter" (r5): row-gather read, but the count WRITE becomes
+    #     chunked one-hot GEMMs on the MXU — oh (chunk, vpb) in bf16
+    #     (0/1 exact) against delta (chunk, K) in bf16 (CGS deltas are
+    #     ±1/0, exact) with f32 accumulation, so counts stay exact while
+    #     the scatter rides the MXU at tens of TF/s instead of the scatter
+    #     unit. CGS only (CVB0's soft deltas are not bf16-exact).
+    #   * "gemm": BOTH sides as full-width f32 one-hot matmuls (legacy).
+    #   "auto" picks gemm_scatter for cgs, gather otherwise.
     num_model_slices: int = 1   # 1 = plain rotate_scan; 2 = the reference's
     #   numModelSlices=2 double-buffered schedule (half-width vocab blocks on
     #   pipelined_rotation: sample one half-slice while the other rotates)
@@ -95,6 +103,39 @@ class LDAConfig:
     #   point; refreshing counts between doc-groups restores near-sequential
     #   mixing (the analog of the reference's per-thread token batches under
     #   the dymoro timer, Scheduler.java:110-121)
+
+
+def _gemm_scatter(flat_ids, flat_delta, vpb: int, chunk: int):
+    """Count update Σ_t onehot(id_t) ⊗ delta_t as chunked bf16 one-hot GEMMs
+    with f32 accumulation (r5): XLA's scatter serializes at ~8.5 ns per
+    128-byte row (82% of the LDA hop); the MXU does the same reduction at
+    tens of TF/s. EXACT for CGS: one-hots are 0/1 and deltas ±1/0 — both
+    bf16-representable — and the accumulator is f32. The one-hot transient
+    is (chunk, vpb) bf16, never the full token count."""
+    n = flat_ids.shape[0]
+    pad = (-n) % chunk
+    if pad:                 # zero-delta pad rows contribute nothing; id 0
+        flat_ids = jnp.concatenate(  # is in-range so the one-hot is valid
+            [flat_ids, jnp.zeros((pad,), flat_ids.dtype)])
+        flat_delta = jnp.concatenate(
+            [flat_delta, jnp.zeros((pad,) + flat_delta.shape[1:],
+                                   flat_delta.dtype)])
+    nch = (n + pad) // chunk
+    k = flat_delta.shape[-1]
+    d_b = flat_delta.astype(jnp.bfloat16)
+
+    def step(acc, xs):
+        ids_c, d_c = xs
+        oh_c = (ids_c[:, None] == jnp.arange(vpb)[None, :]
+                ).astype(jnp.bfloat16)
+        return acc + jax.lax.dot_general(
+            oh_c, d_c, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32), None
+
+    upd, _ = jax.lax.scan(step, jnp.zeros((vpb, k), jnp.float32),
+                          (flat_ids.reshape(nch, chunk),
+                           d_b.reshape(nch, chunk, k)))
+    return upd
 
 
 def bucketize_tokens(docs: np.ndarray, num_blocks: int, vpb: int,
@@ -152,6 +193,10 @@ class LDA:
             raise ValueError(
                 "ablate_stage='sample' only supports method='cgs' (the "
                 "cheap-shift replacement needs integer topic assignments)")
+        if config.wt_access == "gemm_scatter" and config.method != "cgs":
+            raise ValueError(
+                "wt_access='gemm_scatter' requires method='cgs' (CVB0's "
+                "soft deltas are not bf16-exact)")
         self.session = session
         self.config = config
         self._fns = {}
@@ -172,16 +217,32 @@ class LDA:
         shift = 0 if cfg.ablate_rotation else 1
         nmb = self._effective_minibatches(d_local)
         dg = d_local // nmb
-        if cfg.wt_access not in ("auto", "gemm", "gather"):
-            raise ValueError(f"wt_access must be auto|gemm|gather, got "
-                             f"{cfg.wt_access!r}")
-        # the gemm path materializes a (dg*Lb, vpb) f32 one-hot per sub-step;
-        # auto only takes it when the block is narrow AND that operand is
-        # small (<= 256 MB) — wide blocks or huge doc-groups keep the gather
+        if cfg.wt_access not in ("auto", "gemm_scatter", "gemm", "gather"):
+            raise ValueError(f"wt_access must be auto|gemm_scatter|gemm|"
+                             f"gather, got {cfg.wt_access!r}")
+        # legacy full f32 one-hot path: explicit, or auto for CVB0 on
+        # narrow blocks (cvb0's soft deltas cannot take the bf16 route)
         onehot_bytes = dg * lb * vpb * 4
         use_gemm = (cfg.wt_access == "gemm"
-                    or (cfg.wt_access == "auto" and vpb <= 8192
+                    or (cfg.wt_access == "auto" and cfg.method == "cvb0"
+                        and vpb <= 8192
                         and onehot_bytes <= 256 * 1024 * 1024))
+        # gemm_scatter: bf16 one-hot GEMM count writes (exact for CGS's
+        # ±1/0 deltas) instead of the segment_sum that is 82% of the hop.
+        # Chunked so the transient one-hot stays ≤ ~64 MB (_gemm_scatter
+        # pads the token list to a chunk multiple; zero-delta pad rows
+        # contribute nothing).
+        use_gemm_scatter = (cfg.wt_access == "gemm_scatter"
+                            or (cfg.wt_access == "auto"
+                                and cfg.method == "cgs"))
+        budget_chunk = max(1, min(dg * lb,
+                                  (64 * 1024 * 1024) // max(2 * vpb, 1)))
+        # prefer an exact divisor near the budget (no pad concat per group);
+        # fall back to the budget size with zero-delta padding when the
+        # divisors are all small (e.g. dg*lb with a large prime factor)
+        div = next((c for c in range(budget_chunk, 0, -1)
+                    if (dg * lb) % c == 0), 1)
+        scatter_chunk = div if div >= budget_chunk // 2 else budget_chunk
 
         def fit_fn(docs_b, mask_b, z0, wt_block0, seed):
             # docs_b/mask_b/z0: (D_local, NB, Lb) — tokens pre-bucketed by home
@@ -224,11 +285,18 @@ class LDA:
                            * ms_g[..., None])
                     delta = new - cur
                     if not no_scatter:
+                        # the SAME write path as the full run — a stage
+                        # budget computed by subtraction needs the
+                        # unablated stages identical
                         if use_gemm:
                             wt_block = wt_block + jax.lax.dot_general(
                                 oh, delta.reshape(-1, k),
                                 (((0,), (0,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+                        elif use_gemm_scatter:
+                            wt_block = wt_block + _gemm_scatter(
+                                wl_g.reshape(-1), delta.reshape(-1, k),
+                                vpb, scatter_chunk)
                         else:
                             wt_block = wt_block + jax.ops.segment_sum(
                                 delta.reshape(-1, k), wl_g.reshape(-1),
@@ -270,6 +338,10 @@ class LDA:
                     wt_block = wt_block + jax.lax.dot_general(
                         oh, delta.reshape(-1, k), (((0,), (0,)), ((), ())),
                         preferred_element_type=jnp.float32)
+                elif use_gemm_scatter:
+                    wt_block = wt_block + _gemm_scatter(
+                        wl_g.reshape(-1), delta.reshape(-1, k), vpb,
+                        scatter_chunk)
                 else:
                     wt_block = wt_block + jax.ops.segment_sum(
                         delta.reshape(-1, k), wl_g.reshape(-1),
