@@ -891,6 +891,12 @@ def main():
     if small:
         nn_big, nn_big_cpu = None, None
     else:
+        # drop earlier rows' device buffers before the biggest-footprint
+        # config: its in-bench walls showed multi-second variance the
+        # standalone harness never sees (accumulated HBM pressure)
+        import gc
+
+        gc.collect()
         nn_big = tpu_nn(65536, 512, epochs=150, layers=(2048, 1024),
                         batch_size=8192)
         nn_big_cpu = cpu_nn_samples_per_sec(65536, 512, epochs=1,
