@@ -20,6 +20,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from harp_tpu import compat
 from harp_tpu import combiner as combiner_lib
 from harp_tpu.parallel.mesh import WORKERS
 
@@ -30,7 +31,7 @@ def worker_id(axis_name: str = WORKERS) -> jax.Array:
 
 
 def num_workers(axis_name: str = WORKERS) -> int:
-    return jax.lax.axis_size(axis_name)
+    return compat.axis_size(axis_name)
 
 
 def barrier(axis_name: str = WORKERS) -> None:
@@ -111,7 +112,7 @@ def reduce_scatter(
     arrival). SUM/AVG lower to ``psum_scatter``; other algebras lower to
     ``all_to_all`` + a local combine (XLA has no reduce_scatter for max/min).
     """
-    n = jax.lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     if combiner.op in (combiner_lib.Op.SUM, combiner_lib.Op.AVG):
         out = jax.lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
         if combiner.op is combiner_lib.Op.AVG:
@@ -132,7 +133,7 @@ def rotate(x: jax.Array, steps: int = 1, axis_name: str = WORKERS) -> jax.Array:
     Reference: LocalGlobalSyncCollective.rotate:710 (ring or custom rotateMap).
     Lowered to ``ppermute`` which maps 1:1 onto neighbor ICI links.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     perm = [(i, (i + steps) % n) for i in range(n)]
     return jax.lax.ppermute(x, axis_name, perm)
 
@@ -150,7 +151,7 @@ def all_to_all(x: jax.Array, axis_name: str = WORKERS) -> jax.Array:
     The substrate for general regroup and for Ulysses-style sequence parallelism.
     ``x`` has shape (n*block, ...); result has the same shape.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     block = x.shape[0] // n
     chunks = x.reshape((n, block) + x.shape[1:])
     out = jax.lax.all_to_all(chunks, axis_name, split_axis=0, concat_axis=0)

@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from harp_tpu import compat
 from harp_tpu import combiner as combiner_lib
 from harp_tpu.collectives.table_ops import (bucket_route,
                                             default_route_capacity,
@@ -162,7 +163,7 @@ class DistributedKV:
         """Route records to their owners and combine into the local stores.
         Returns (new DistributedKV, route_overflow, store_overflow). Masked
         (padding) records are excluded without consuming route capacity."""
-        w = jax.lax.axis_size(self.axis_name)
+        w = compat.axis_size(self.axis_name)
         n = keys.shape[0]
         cap = route_cap or default_route_capacity(n, w)
         k = keys.astype(jnp.int32)
@@ -184,7 +185,7 @@ class DistributedKV:
         (values, found) in the original query order; capacity-dropped or
         padding queries (``mask=False`` or the sentinel key) come back as
         (default, False) without consuming route capacity."""
-        w = jax.lax.axis_size(self.axis_name)
+        w = compat.axis_size(self.axis_name)
         n = keys.shape[0]
         cap = route_cap or default_route_capacity(n, w)
         k = keys.astype(jnp.int32)
@@ -358,7 +359,7 @@ class DistributedKV64:
                route_cap: int = 0, mask=None):
         """Route (hi, lo, val) records to owners and combine. Returns
         (new DistributedKV64, route_overflow, store_overflow)."""
-        w = jax.lax.axis_size(self.axis_name)
+        w = compat.axis_size(self.axis_name)
         n = hi.shape[0]
         cap = route_cap or default_route_capacity(n, w)
         h = hi.astype(jnp.int32)
@@ -381,7 +382,7 @@ class DistributedKV64:
     def lookup(self, hi, lo, default=0, route_cap: int = 0, mask=None):
         """Distributed get over 64-bit keys; same contract as
         DistributedKV.lookup."""
-        w = jax.lax.axis_size(self.axis_name)
+        w = compat.axis_size(self.axis_name)
         n = hi.shape[0]
         cap = route_cap or default_route_capacity(n, w)
         h = hi.astype(jnp.int32)

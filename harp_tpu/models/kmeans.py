@@ -234,25 +234,32 @@ class KMeans:
         scan, so interrupted + resumed trajectories are bitwise identical to
         uninterrupted ones. Returns (centroids, costs-for-run-iterations,
         start_iteration)."""
+        from harp_tpu.parallel import faults
+
         total = iterations if iterations is not None else \
             self.config.iterations
         start = 0
-        latest = checkpointer.steps()
-        if latest:
-            start = latest[-1]
+        # verified resume, single read: a corrupt/torn newest checkpoint is
+        # skipped in favor of the previous step (manifest checksums) instead
+        # of crashing the relaunch
+        resume, saved = checkpointer.restore_latest_valid(
+            like={"centroids": np.zeros(cen.shape, cen.dtype)})
+        if resume is not None:
+            start = resume
             if start > total:
                 raise ValueError(
                     f"checkpoint at iteration {start} exceeds the requested "
                     f"{total} iterations (pass a fresh directory or a larger "
                     f"budget)")
-            saved = checkpointer.restore(
-                start, like={"centroids": np.zeros(cen.shape, cen.dtype)})
             cen = self.session.replicate_put(
                 jnp.asarray(saved["centroids"]))
         chunk_fits = {}
         costs = []
         it = start
         while it < total:
+            # iteration-boundary fault hook (parallel.faults): a scripted
+            # crash/hang lands here, where a real preemption is survivable
+            faults.fire(it + 1, checkpointer)
             chunk = min(save_every, total - it)
             if chunk not in chunk_fits:
                 chunk_fits[chunk] = KMeans(

@@ -588,22 +588,24 @@ class LDA:
         sess, cfg = self.session, self.config
         key, data, seed, (word_block, word_slot, vpb) = state
         docs_b, mask_b, z_cur, wt_cur = data
+        from harp_tpu.parallel import faults
+
         total = epochs if epochs is not None else cfg.epochs
         start = 0
-        latest = checkpointer.steps()
-        if latest:
-            start = latest[-1]
+        # verified resume, single read: manifest-checksummed steps only (a
+        # corrupt newest checkpoint falls back to the previous step,
+        # utils.checkpoint). `like` only conveys tree structure + dtypes:
+        # host zeros, not a full D2H gather of the device arrays (advisor r3)
+        resume, saved = checkpointer.restore_latest_valid(
+            like={"z": np.zeros(z_cur.shape, z_cur.dtype),
+                  "wt": np.zeros(wt_cur.shape, wt_cur.dtype)})
+        if resume is not None:
+            start = resume
             if start > total:
                 raise ValueError(
                     f"checkpoint at epoch {start} exceeds the requested "
                     f"{total} epochs (pass a fresh directory or a larger "
                     f"budget)")
-            # `like` only conveys tree structure + dtypes: host zeros, not a
-            # full D2H gather of the device arrays (advisor r3)
-            saved = checkpointer.restore(
-                start,
-                like={"z": np.zeros(z_cur.shape, z_cur.dtype),
-                      "wt": np.zeros(wt_cur.shape, wt_cur.dtype)})
             z_cur = sess.scatter(jnp.asarray(saved["z"]))
             wt_cur = sess.scatter(jnp.asarray(saved["wt"]))
         w, v_pad, lb, num_docs = key[:4]
@@ -612,6 +614,8 @@ class LDA:
         doc_topic = None
         ep = start
         while ep < total:
+            # iteration-boundary fault hook (parallel.faults)
+            faults.fire(ep + 1, checkpointer)
             # stay on the save_every grid so an interrupted run's chunk
             # boundaries (hence per-chunk RNG keys) match an uninterrupted one
             chunk = min(save_every - ep % save_every, total - ep)

@@ -739,32 +739,37 @@ class SGDMF:
         interrupted + resumed run produces exactly the trajectory of an
         uninterrupted run at the same per-epoch program granularity.
         """
+        from harp_tpu.parallel import faults
+
         layout, data, w0, h0, meta = state
         geom = meta[6]
         nmb = self.config.minibatches_per_hop
         epochs = epochs if epochs is not None else self.config.epochs
         w_cur, h_cur = w0, h0
         start = 0
-        latest = checkpointer.steps()
-        if latest:
-            start = latest[-1]
+        # verified resume, single read: manifest-checksummed steps only (a
+        # corrupt newest checkpoint falls back to the previous step,
+        # utils.checkpoint). `like` only conveys tree structure + dtypes:
+        # host zeros, not a full (gang-collective) D2H gather of the factors
+        resume, saved = checkpointer.restore_latest_valid(
+            like={"w": np.zeros(w0.shape, w0.dtype),
+                  "h": np.zeros(h0.shape, h0.dtype)})
+        if resume is not None:
+            start = resume
             if start > epochs:
                 raise ValueError(
                     f"checkpoint at epoch {start} exceeds the requested "
                     f"{epochs} epochs — the saved model is already trained "
                     f"past this budget (pass a fresh checkpoint directory "
                     f"or a larger epochs)")
-            # `like` only conveys tree structure + dtypes: host zeros, not a
-            # full (gang-collective) D2H gather of the initial factors
-            saved = checkpointer.restore(
-                start, like={"w": np.zeros(w0.shape, w0.dtype),
-                             "h": np.zeros(h0.shape, h0.dtype)})
             w_cur = jax.device_put(saved["w"], w0.sharding)
             h_cur = jax.device_put(saved["h"], h0.sharding)
         key = self._program(layout, nmb, 1, geom)
         fn = self._compiled[key]
         rmses = []
         for epoch in range(start, epochs):
+            # iteration-boundary fault hook (parallel.faults)
+            faults.fire(epoch + 1, checkpointer)
             w_cur, h_cur, r = fn(*data, w_cur, h_cur)
             rmses.append(np.asarray(r)[0])
             if (epoch + 1) % save_every == 0 or epoch + 1 == epochs:

@@ -25,6 +25,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from harp_tpu import compat
 from harp_tpu.ops import distance as xla_path
 
 try:
@@ -259,7 +260,8 @@ def dense_mf_hop_pallas(vb: jax.Array, w_t: jax.Array, h_t: jax.Array,
             jax.ShapeDtypeStruct((1, 128), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((k, s), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
+            pltpu,
             vmem_limit_bytes=min(int(vmem_bytes), 100 * 1024 * 1024)),
         interpret=interpret,
     )(vb, w_t, rc8, cc8, h_t)

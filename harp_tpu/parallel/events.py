@@ -84,11 +84,14 @@ def _broadcast_payload(payload: Any, source: int) -> Any:
             if is_source else np.zeros(0, np.uint8))
     n = int(multihost_utils.broadcast_one_to_all(
         np.int64(len(data)), is_source=is_source))
-    buf = np.zeros(n, np.uint8)
+    # int32 wire format: 0.4.x gloo transports uint8 widened to int32 and
+    # never narrows back, corrupting the byte stream — one value per byte
+    # is version-proof, and the control plane is tiny
+    buf = np.zeros(n, np.int32)
     if is_source:
         buf[:] = data[:n]
     out = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
-    return pickle.loads(np.asarray(out).tobytes())
+    return pickle.loads(np.asarray(out).astype(np.uint8).tobytes())
 
 
 class EventClient:

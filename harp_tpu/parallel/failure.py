@@ -11,6 +11,8 @@ the watchdog instead of blocking for half an hour.
 
 from __future__ import annotations
 
+import itertools
+import logging
 import os
 import sys
 import threading
@@ -20,8 +22,19 @@ from typing import Callable, Optional
 import jax
 import numpy as np
 
+log = logging.getLogger("harp_tpu.failure")
+
 DEFAULT_TIMEOUT_S = 60.0        # vs the reference's 1800 s
 GANG_WATCHDOG_EXIT = 98         # exit code a watchdog fail-stop uses
+
+# A probe whose jax.device_put hangs leaves its thread stuck until the device
+# recovers; with one probe per heartbeat interval a dead device would grow an
+# orphan thread forever. Cap them: past the cap the device is considered dead
+# without spending another thread.
+MAX_ORPHAN_PROBES = 8
+_probe_seq = itertools.count()
+_orphan_lock = threading.Lock()
+_orphan_probes: set = set()
 
 
 class WorkerFailure(RuntimeError):
@@ -30,6 +43,15 @@ class WorkerFailure(RuntimeError):
 
 def probe_devices(timeout_s: float = DEFAULT_TIMEOUT_S) -> bool:
     """One liveness probe: a tiny computation must complete within deadline."""
+    with _orphan_lock:
+        live = {t for t in _orphan_probes if t.is_alive()}
+        _orphan_probes.clear()
+        _orphan_probes.update(live)
+        if len(live) >= MAX_ORPHAN_PROBES:
+            log.warning("%d probe threads already stuck in jax.device_put — "
+                        "treating the device as dead without spawning more",
+                        len(live))
+            return False
     done = threading.Event()
     err: list = []
 
@@ -41,10 +63,15 @@ def probe_devices(timeout_s: float = DEFAULT_TIMEOUT_S) -> bool:
             err.append(e)
             done.set()
 
-    t = threading.Thread(target=_run, daemon=True)
+    t = threading.Thread(target=_run, daemon=True,
+                         name=f"harp-probe-{next(_probe_seq)}")
+    with _orphan_lock:
+        _orphan_probes.add(t)
     t.start()
     if not done.wait(timeout_s):
-        return False
+        return False                 # t stays in _orphan_probes until it dies
+    with _orphan_lock:
+        _orphan_probes.discard(t)
     return not err
 
 
@@ -76,7 +103,13 @@ class Watchdog:
                 self.failed = True
                 if self.on_failure is not None:
                     self.on_failure()
-                return
+                    return
+                # no handler: keep probing and logging rather than silently
+                # parking — ok() stays armed (failed is sticky) but the log
+                # keeps reporting, so a main thread that never calls ok()
+                # still leaves evidence
+                log.warning("device heartbeat missed deadline (no on_failure "
+                            "handler) — flagged; continuing to probe")
 
     def ok(self) -> None:
         """Call at iteration boundaries; raises if a heartbeat failed

@@ -1,0 +1,193 @@
+"""Deterministic fault injection — the test surface for the elastic supervisor.
+
+The reference had no way to exercise its failure path short of killing JVMs by
+hand; its fail-stop story ("Slaves may fail", Communication.java:82) was
+observed in production, never scripted. Here faults are declared in the
+environment and fire at iteration boundaries of the checkpointed training
+loops, so a test can write the whole recovery scenario down::
+
+    HARP_FAULT="crash@epoch=3:rank=1"
+
+Grammar: comma-separated specs, each ``<kind>@key=value[:key=value...]``.
+
+kinds
+    ``crash``        ``os._exit(FAULT_CRASH_EXIT)`` — a hard member death.
+    ``hang``         sleep forever — exercises the watchdog / launch timeout.
+    ``ckpt-corrupt`` flip bytes in the newest completed checkpoint's
+                     ``arrays.npz`` — exercises the manifest-checksum
+                     fallback on resume.
+
+keys
+    ``epoch=N``   (required) fire at the first iteration boundary that
+                  reaches epoch N: ``crash``/``hang`` fire *before* epoch N
+                  runs (so the newest checkpoint is at most N-1);
+                  ``ckpt-corrupt`` fires once epoch N's checkpoint exists.
+    ``rank=R``    only this gang member fires (HARP_PROCESS_ID; a process
+                  outside a gang is rank 0). Omitted = every rank.
+    ``attempt=A`` only fire on supervisor attempt A (HARP_GANG_ATTEMPT,
+                  0 outside the supervisor). Default 0 — the fault fires on
+                  the first launch and NOT again after a relaunch, which is
+                  what makes "die once, recover, finish" scriptable.
+
+The hooks are checked host-side between compiled chunks (the models'
+``fit_checkpointed`` loops), never inside XLA programs: a fault can only
+land where a real preemption could be survived.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import time
+from typing import List, Optional
+
+FAULT_CRASH_EXIT = 41      # distinct from the watchdog's 98: a scripted death
+_KINDS = ("crash", "hang", "ckpt-corrupt")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    kind: str
+    epoch: int
+    rank: Optional[int] = None      # None = every rank
+    attempt: int = 0
+
+
+def parse_faults(text: str) -> List[FaultSpec]:
+    """Parse the ``HARP_FAULT`` grammar; raises ValueError with the offending
+    token so a typo fails the job loudly instead of silently not injecting."""
+    specs = []
+    for part in filter(None, (p.strip() for p in text.split(","))):
+        if "@" not in part:
+            raise ValueError(f"fault spec {part!r}: expected <kind>@key=value")
+        kind, _, argstr = part.partition("@")
+        if kind not in _KINDS:
+            raise ValueError(f"fault kind {kind!r}: expected one of {_KINDS}")
+        kv = {}
+        for item in filter(None, argstr.split(":")):
+            key, eq, val = item.partition("=")
+            if not eq or key not in ("epoch", "rank", "attempt"):
+                raise ValueError(f"fault spec {part!r}: bad argument "
+                                 f"{item!r} (epoch=/rank=/attempt=)")
+            try:
+                kv[key] = int(val)
+            except ValueError:
+                raise ValueError(f"fault spec {part!r}: {key}={val!r} is "
+                                 f"not an integer") from None
+        if "epoch" not in kv:
+            raise ValueError(f"fault spec {part!r}: epoch= is required")
+        specs.append(FaultSpec(kind, kv["epoch"], kv.get("rank"),
+                               kv.get("attempt", 0)))
+    return specs
+
+
+_cache_key: Optional[str] = None
+_cache_specs: List[FaultSpec] = []
+_fired: set = set()
+
+
+def _plan() -> List[FaultSpec]:
+    # re-parse when the env var changes (tests set it after import); the
+    # fired-set resets with it so each scripted plan starts fresh
+    global _cache_key, _cache_specs
+    text = os.environ.get("HARP_FAULT", "")
+    if text != _cache_key:
+        # parse BEFORE updating the cache key: if the spec is malformed the
+        # ValueError must re-raise on every boundary, not just the first —
+        # otherwise a caught first failure leaves a stale plan installed and
+        # the scripted fault silently never fires
+        specs = parse_faults(text) if text else []
+        _cache_key = text
+        _cache_specs = specs
+        _fired.clear()
+    return _cache_specs
+
+
+def _me() -> int:
+    return int(os.environ.get("HARP_PROCESS_ID", "0"))
+
+
+def _attempt() -> int:
+    return int(os.environ.get("HARP_GANG_ATTEMPT", "0"))
+
+
+def fire(next_epoch: int, checkpointer=None) -> None:
+    """Iteration-boundary hook: called by the checkpointed training loops
+    with the 1-based epoch about to run. Executes any armed fault whose
+    trigger point has been reached (each spec fires at most once per
+    process). ``checkpointer`` (utils.checkpoint.Checkpointer) is required
+    for ``ckpt-corrupt`` to find its target."""
+    specs = _plan()
+    if not specs:
+        return
+    me, attempt = _me(), _attempt()
+    # corruption first: a same-boundary "corrupt then crash" plan must
+    # damage the checkpoint before the death ends the process
+    order = sorted(specs, key=lambda s: s.kind != "ckpt-corrupt")
+    for spec in order:
+        if spec in _fired or spec.attempt != attempt:
+            continue
+        if spec.rank is not None and spec.rank != me:
+            continue
+        due = (next_epoch - 1 >= spec.epoch if spec.kind == "ckpt-corrupt"
+               else next_epoch >= spec.epoch)
+        if not due:
+            continue
+        _fired.add(spec)
+        _execute(spec, checkpointer)
+
+
+def _execute(spec: FaultSpec, checkpointer) -> None:
+    print(f"harp_tpu.faults: firing {spec.kind}@epoch={spec.epoch} "
+          f"(rank {_me()}, attempt {_attempt()})", file=sys.stderr, flush=True)
+    if spec.kind == "crash":
+        os._exit(FAULT_CRASH_EXIT)
+    if spec.kind == "hang":
+        while True:          # parked until the watchdog / launch timeout
+            time.sleep(3600)
+    # ckpt-corrupt
+    if checkpointer is None:
+        print("harp_tpu.faults: ckpt-corrupt armed but no checkpointer at "
+              "this boundary — skipping", file=sys.stderr, flush=True)
+        return
+    if hasattr(checkpointer, "wait"):
+        checkpointer.wait()              # the target write must be on disk
+    corrupt_latest(checkpointer.directory)
+
+
+def corrupt_latest(directory: str) -> Optional[str]:
+    """Flip bytes in the middle of the newest step's payload — ``arrays.npz``
+    for the numpy format, otherwise every payload file in the step dir
+    (orbax's OCDBT layout keeps redundant staging copies, so damaging one
+    file is not guaranteed to reach the copy restore reads). The manifest
+    itself is left intact so the CRC check has something true to disagree
+    with. Returns the damaged arrays.npz path or the step dir, or None if
+    there was nothing to damage. Exposed for tests."""
+    from harp_tpu.utils.checkpoint import list_step_numbers
+
+    def _flip(path: str) -> None:
+        with open(path, "r+b") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(size // 2)
+            chunk = f.read(16)
+            f.seek(size // 2)
+            f.write(bytes(b ^ 0xFF for b in chunk))
+
+    for step in reversed(list_step_numbers(directory)):
+        step_dir = os.path.join(directory, f"step_{step:012d}")
+        npz = os.path.join(step_dir, "arrays.npz")
+        if os.path.isfile(npz):
+            _flip(npz)
+            return npz
+        flipped = False
+        for root, _, names in os.walk(step_dir):
+            for name in names:
+                if name == "manifest.json":
+                    continue
+                _flip(os.path.join(root, name))
+                flipped = True
+        if flipped:
+            return step_dir
+    return None

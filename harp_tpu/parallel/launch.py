@@ -42,6 +42,33 @@ class Node:
     rack: int
 
 
+class GangResult(list):
+    """Per-member ``(returncode, combined output)`` in node order, plus which
+    member was observed failing FIRST. The fail-stop kill makes every
+    survivor exit non-zero too — without this attribute the caller cannot
+    tell the instigator from the victims (the reference master only ever
+    logged the aggregate "Slaves may fail", Communication.java:82)."""
+
+    def __init__(self, items, first_failure: Optional[Tuple[int, int]] = None):
+        super().__init__(items)
+        #: (rank, returncode) of the first member seen exiting non-zero, or
+        #: None when every member exited cleanly. When several members die
+        #: within one poll interval the lowest rank is reported.
+        self.first_failure = first_failure
+
+    @property
+    def ok(self) -> bool:
+        return all(rc == 0 for rc, _ in self)
+
+    @property
+    def first_failed_rank(self) -> Optional[int]:
+        return None if self.first_failure is None else self.first_failure[0]
+
+    @property
+    def first_failed_rc(self) -> Optional[int]:
+        return None if self.first_failure is None else self.first_failure[1]
+
+
 def parse_nodes_file(path: str) -> List[Node]:
     """Parse the reference's nodes format: ``#<rackID>`` headers, one
     hostname per following line (worker/Nodes.java:37; test fixture
@@ -102,25 +129,32 @@ def _drain(proc: subprocess.Popen, sink: List[str]) -> None:
 def launch(nodes: Sequence[Node], command: List[str], port: int = 0,
            timeout: Optional[float] = 1800.0,
            poll_interval: float = 0.05,
-           cwd: Optional[str] = None) -> List[Tuple[int, str]]:
+           cwd: Optional[str] = None,
+           extra_env: Optional[dict] = None) -> GangResult:
     """Launch ``command`` once per node with the gang env; wait for all.
     ``cwd`` sets every member's working directory (local Popen cwd, remote
-    ``cd``); default = this process's.
+    ``cd``); default = this process's. ``extra_env`` adds variables on top of
+    the gang env (the supervisor stamps HARP_GANG_ATTEMPT through it).
 
-    Returns [(returncode, combined output)] in node order. Fail-stop: all
-    members are polled concurrently (stdout drained by threads), and the
+    Returns a :class:`GangResult` — [(returncode, combined output)] in node
+    order with ``first_failure`` naming the instigating member. Fail-stop:
+    all members are polled concurrently (stdout drained by threads), and the
     moment any member exits non-zero the rest of the gang is killed — a
     crashed member never leaves survivors blocked in the jax.distributed
     rendezvous until the timeout (the reference's gang allocator never
     re-executed workers, SURVEY §5). The 1800 s default timeout mirrors
-    DATA_MAX_WAIT_TIME (io/Constant.java:36)."""
+    DATA_MAX_WAIT_TIME (io/Constant.java:36). On timeout the raised
+    ``subprocess.TimeoutExpired`` carries the partial per-member output
+    (``.member_outputs`` list, and joined into ``.output``) instead of
+    discarding it."""
     if port == 0:
         import socket
 
         with socket.socket() as s:
             s.bind(("", 0))
             port = s.getsockname()[1]
-    procs = [_spawn(node, gang_env(nodes, i, port), command, cwd=cwd)
+    procs = [_spawn(node, {**gang_env(nodes, i, port), **(extra_env or {})},
+                    command, cwd=cwd)
              for i, node in enumerate(nodes)]
     sinks: List[List[str]] = [[] for _ in procs]
     drains = [threading.Thread(target=_drain, args=(p, s), daemon=True)
@@ -128,6 +162,7 @@ def launch(nodes: Sequence[Node], command: List[str], port: int = 0,
     for t in drains:
         t.start()
     deadline = None if timeout is None else time.monotonic() + timeout
+    first_failure: Optional[Tuple[int, int]] = None
     try:
         pending = set(range(len(procs)))
         while pending:
@@ -137,13 +172,23 @@ def launch(nodes: Sequence[Node], command: List[str], port: int = 0,
                     continue
                 pending.discard(i)
                 if rc != 0:  # fail-stop: kill the survivors immediately
+                    if first_failure is None:
+                        first_failure = (i, rc)
                     for j in pending:
                         procs[j].kill()
             if pending and deadline is not None and \
                     time.monotonic() > deadline:
                 for j in pending:
                     procs[j].kill()
-                raise subprocess.TimeoutExpired(command, timeout)
+                for t in drains:
+                    t.join(timeout=10.0)
+                outputs = ["".join(s) for s in sinks]
+                exc = subprocess.TimeoutExpired(
+                    command, timeout,
+                    output="".join(f"--- member {i} (partial) ---\n{out}"
+                                   for i, out in enumerate(outputs)))
+                exc.member_outputs = outputs
+                raise exc
             if pending:
                 time.sleep(poll_interval)
     finally:
@@ -152,7 +197,8 @@ def launch(nodes: Sequence[Node], command: List[str], port: int = 0,
                 p.kill()
         for t in drains:
             t.join(timeout=10.0)
-    return [(p.returncode, "".join(s)) for p, s in zip(procs, sinks)]
+    return GangResult([(p.returncode, "".join(s))
+                       for p, s in zip(procs, sinks)], first_failure)
 
 
 def smoke_command() -> List[str]:

@@ -26,6 +26,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from harp_tpu import compat
 from harp_tpu.collectives import lax_ops, rotation
 from harp_tpu.parallel.mesh import WORKERS
 
@@ -70,7 +71,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     accumulates (flash-attention update rule), so the result is EXACT attention,
     bit-comparable to the replicated reference up to float associativity.
     """
-    w = jax.lax.axis_size(axis_name)
+    w = compat.axis_size(axis_name)
     scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
     wid = lax_ops.worker_id(axis_name)
     lq = q.shape[0]
@@ -122,7 +123,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     head, and all_to_alls back. num_heads must divide the worker count's
     multiple (H % W == 0).
     """
-    w = jax.lax.axis_size(axis_name)
+    w = compat.axis_size(axis_name)
     l_local, h, dh = q.shape
     if num_heads != h:
         raise ValueError(f"num_heads={num_heads} != q.shape[1]={h}")

@@ -1,0 +1,397 @@
+"""Elastic gang supervisor — relaunch-from-checkpoint over the fail-stop launcher.
+
+Reference parity (SURVEY §5): Harp's failure handling ENDED at detection — after
+the 1800 s DATA_MAX_WAIT_TIME the master logged "Slaves may fail"
+(Communication.java:82) and the job died; the gang allocator never re-executed
+workers. The seed already beats the detection latency (watchdog fail-stop,
+``parallel.failure``/``parallel.launch``) and has atomic checkpoints
+(``utils.checkpoint``); this module closes the loop: when a gang member dies,
+kill the gang (existing fail-stop), classify the failure, back off, and
+relaunch the SAME command on the SAME work dir — the checkpointed training
+loops resume from the newest verified checkpoint, and Lloyd-style determinism
+makes the recovered run bitwise-equal to an uninterrupted one.
+
+Policy:
+
+* bounded restart budget (``--max-restarts``), exponential backoff with a cap;
+* per-failure-class handling: repeated watchdog exits (exit 98) from the SAME
+  rank mark that node suspect and abort early — restarting onto a host with a
+  dying accelerator burns the budget without ever finishing;
+* every relaunch appends a JSONL record to the restart journal (attempt,
+  cause, first failing rank, backoff, the step the relaunch resumes from) and
+  bumps counters in ``utils.metrics``.
+
+Each attempt is stamped with ``HARP_GANG_ATTEMPT=<n>`` in the member
+environment, which the deterministic fault layer (``parallel.faults``) keys on
+— a scripted ``HARP_FAULT=crash@epoch=3:rank=1`` kills the gang exactly once
+and the relaunch runs clean. CLI::
+
+    python -m harp_tpu.parallel.supervisor nodes.txt --max-restarts 2 \\
+        --work-dir /tmp/km -- python -m harp_tpu.run kmeans ...
+
+Multi-host note: relaunch is currently local-subprocess only — remote (ssh)
+members are killed fail-stop but a node that VANISHES (ssh unreachable) is
+indistinguishable from a crash; re-placement onto spare hosts is an open item
+(ROADMAP).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import os
+import subprocess
+import sys
+import time
+from collections import Counter
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from harp_tpu.parallel import launch as launch_mod
+
+# parallel.failure.GANG_WATCHDOG_EXIT — mirrored here (not imported): the
+# supervisor must never touch device-probing machinery or initialize a jax
+# backend (the children own the accelerator); importing failure just to
+# compare an exit code would wire in both.
+WATCHDOG_EXIT = 98
+
+
+class FailureClass(enum.Enum):
+    CLEAN = "clean"
+    CRASH = "crash"          # any unexplained non-zero exit (incl. faults)
+    WATCHDOG = "watchdog"    # device heartbeat fail-stop (exit 98)
+    TIMEOUT = "timeout"      # the whole gang exceeded the launch deadline
+
+
+def classify(result: launch_mod.GangResult
+             ) -> Tuple[FailureClass, Optional[int], Optional[int]]:
+    """(class, first failing rank, its exit code) for one gang attempt."""
+    if result.ok:
+        return FailureClass.CLEAN, None, None
+    rank, rc = result.first_failure
+    cls = FailureClass.WATCHDOG if rc == WATCHDOG_EXIT else FailureClass.CRASH
+    return cls, rank, rc
+
+
+@dataclasses.dataclass(frozen=True)
+class RestartPolicy:
+    """Restart budget + backoff + per-class rules."""
+
+    max_restarts: int = 2
+    backoff_base_s: float = 1.0
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 60.0
+    # a rank whose member dies by watchdog this many times is a suspect node
+    # (dying accelerator / wedged driver): abort instead of burning budget
+    watchdog_suspect_after: int = 2
+    # exit codes that are deterministic, not transient — relaunching cannot
+    # help (2 = argparse usage error: bad flags fail identically every time)
+    non_retryable_rcs: Tuple[int, ...] = (2,)
+
+    def backoff(self, restart_index: int) -> float:
+        """Backoff before restart #``restart_index`` (0-based), capped."""
+        return min(self.backoff_base_s * self.backoff_factor ** restart_index,
+                   self.backoff_max_s)
+
+
+@dataclasses.dataclass
+class SuperviseOutcome:
+    ok: bool
+    attempts: int                     # launches performed (>= 1)
+    results: Optional[launch_mod.GangResult]   # last attempt (None: timeout)
+    journal: List[dict]               # every record written (also on disk)
+    gave_up: Optional[str] = None     # "budget" | "suspect-node" | None
+
+
+class _Journal:
+    """Append-only JSONL restart journal (also kept in memory)."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self.records: List[dict] = []
+        if path:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+    def append(self, record: dict) -> None:
+        record = {"ts": time.time(), **record}
+        self.records.append(record)
+        if self.path:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+                f.flush()
+
+
+def _resumed_step(checkpoint_dir: Optional[str]) -> Optional[int]:
+    if not checkpoint_dir:
+        return None
+    from harp_tpu.utils import checkpoint as ckpt_mod
+
+    # deep=False: the journal field is advisory — the supervisor must not
+    # initialize a jax backend (on TPU it would hold the accelerator against
+    # the relaunched gang) or pay a full orbax restore between attempts; the
+    # npz CRC check (the gang wire format) still runs, and the training
+    # child re-verifies deeply before trusting the state
+    return ckpt_mod.latest_valid_step(checkpoint_dir, deep=False)
+
+
+def supervise(nodes: Sequence[launch_mod.Node], command: List[str], *,
+              policy: Optional[RestartPolicy] = None,
+              timeout: Optional[float] = 1800.0,
+              cwd: Optional[str] = None,
+              checkpoint_dir: Optional[str] = None,
+              journal_path: Optional[str] = None,
+              metrics=None,
+              metrics_path: Optional[str] = None,
+              sleep: Callable[[float], None] = time.sleep,
+              echo: bool = False) -> SuperviseOutcome:
+    """Run ``command`` as a gang under the elastic restart policy.
+
+    Wraps :func:`launch.launch`; every relaunch reuses the same nodes/command
+    (the checkpointed training loops make the relaunch resume). ``sleep`` is
+    injectable so tests can assert the backoff schedule without waiting it.
+    """
+
+    def attempt_fn(extra_env):
+        return launch_mod.launch(nodes, command, timeout=timeout, cwd=cwd,
+                                 extra_env=extra_env)
+
+    hosts = [n.host for n in nodes]
+    return _supervise(attempt_fn, hosts, policy=policy,
+                      checkpoint_dir=checkpoint_dir,
+                      journal_path=journal_path, metrics=metrics,
+                      metrics_path=metrics_path, sleep=sleep, echo=echo)
+
+
+def supervise_local(command: List[str], *,
+                    policy: Optional[RestartPolicy] = None,
+                    timeout: Optional[float] = 1800.0,
+                    cwd: Optional[str] = None,
+                    checkpoint_dir: Optional[str] = None,
+                    journal_path: Optional[str] = None,
+                    metrics=None,
+                    metrics_path: Optional[str] = None,
+                    sleep: Callable[[float], None] = time.sleep,
+                    echo: bool = False) -> SuperviseOutcome:
+    """Single-process flavor: supervise a plain subprocess (no gang env).
+
+    This is what ``harp_tpu.run --max-restarts N`` uses outside a gang — the
+    same classify/backoff/journal machinery with a one-member "gang". With
+    ``echo`` the child's output STREAMS through as it runs (a supervised
+    training job must not go dark for hours); the returned GangResult keeps
+    only the TAIL of the output (the supervisor may babysit a multi-day job
+    — retaining every line just to diagnose the exit would grow without
+    bound)."""
+    import collections
+    import threading
+
+    def attempt_fn(extra_env):
+        proc = subprocess.Popen(
+            command, env={**os.environ, **extra_env}, cwd=cwd,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        sink: collections.deque = collections.deque(maxlen=10_000)
+
+        def _drain():
+            for line in proc.stdout:
+                sink.append(line)
+                if echo:
+                    sys.stdout.write(line)
+                    sys.stdout.flush()
+            proc.stdout.close()
+
+        drain = threading.Thread(target=_drain, daemon=True)
+        drain.start()
+        try:
+            rc = proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+            drain.join(timeout=10.0)
+            exc = subprocess.TimeoutExpired(command, timeout,
+                                            output="".join(sink))
+            exc.member_outputs = ["".join(sink)]
+            raise exc
+        drain.join(timeout=10.0)
+        return launch_mod.GangResult(
+            [(rc, "".join(sink))],
+            first_failure=None if rc == 0 else (0, rc))
+
+    # echo is handled line-by-line above — _supervise must not re-print the
+    # buffered output a second time
+    return _supervise(attempt_fn, ["localhost"], policy=policy,
+                      checkpoint_dir=checkpoint_dir,
+                      journal_path=journal_path, metrics=metrics,
+                      metrics_path=metrics_path, sleep=sleep, echo=False)
+
+
+def _supervise(attempt_fn, hosts: List[str], *, policy, checkpoint_dir,
+               journal_path, metrics, metrics_path, sleep,
+               echo) -> SuperviseOutcome:
+    if metrics is None:
+        from harp_tpu.utils.metrics import DEFAULT as metrics
+    policy = policy or RestartPolicy()
+    journal = _Journal(journal_path)
+    watchdog_deaths: Counter = Counter()
+    attempt = 0
+    while True:
+        extra = {"HARP_GANG_ATTEMPT": str(attempt), "HARP_SUPERVISED": "1"}
+        t0 = time.monotonic()
+        timed_out = False
+        results = None
+        try:
+            results = attempt_fn(extra)
+            cause, rank, rc = classify(results)
+        except subprocess.TimeoutExpired as e:
+            timed_out = True
+            cause, rank, rc = FailureClass.TIMEOUT, None, None
+            if echo:
+                for i, out in enumerate(getattr(e, "member_outputs", [])):
+                    _echo_member(i, None, out, partial=True)
+        elapsed = round(time.monotonic() - t0, 3)
+        if echo and results is not None:
+            for i, (mrc, out) in enumerate(results):
+                _echo_member(i, mrc, out)
+        metrics.count("supervisor.attempts")
+        if cause is FailureClass.CLEAN:
+            if attempt > 0:
+                metrics.count("supervisor.recoveries")
+            journal.append({"event": "success", "attempt": attempt,
+                            "restarts": attempt, "elapsed_s": elapsed})
+            _finish(metrics, metrics_path)
+            return SuperviseOutcome(True, attempt + 1, results,
+                                    journal.records)
+        metrics.count("supervisor.failures")
+        metrics.count(f"supervisor.failures.{cause.value}")
+        if cause is FailureClass.WATCHDOG and rank is not None:
+            watchdog_deaths[rank] += 1
+            if watchdog_deaths[rank] >= policy.watchdog_suspect_after:
+                journal.append({"event": "abort-suspect", "attempt": attempt,
+                                "cause": cause.value, "first_rank": rank,
+                                "host": hosts[rank],
+                                "watchdog_deaths": watchdog_deaths[rank],
+                                "elapsed_s": elapsed})
+                metrics.count("supervisor.aborts.suspect_node")
+                _finish(metrics, metrics_path)
+                return SuperviseOutcome(False, attempt + 1, results,
+                                        journal.records,
+                                        gave_up="suspect-node")
+        if rc in policy.non_retryable_rcs:
+            journal.append({"event": "abort-non-retryable",
+                            "attempt": attempt, "cause": cause.value,
+                            "first_rank": rank, "first_rc": rc,
+                            "elapsed_s": elapsed})
+            metrics.count("supervisor.aborts.non_retryable")
+            _finish(metrics, metrics_path)
+            return SuperviseOutcome(False, attempt + 1, results,
+                                    journal.records, gave_up="non-retryable")
+        if attempt >= policy.max_restarts:
+            journal.append({"event": "give-up", "attempt": attempt,
+                            "cause": cause.value, "first_rank": rank,
+                            "first_rc": rc,
+                            "restarts": attempt,
+                            "max_restarts": policy.max_restarts,
+                            "elapsed_s": elapsed})
+            metrics.count("supervisor.aborts.budget")
+            _finish(metrics, metrics_path)
+            return SuperviseOutcome(False, attempt + 1, results,
+                                    journal.records, gave_up="budget")
+        backoff = policy.backoff(attempt)
+        resumed = _resumed_step(checkpoint_dir)
+        journal.append({
+            "event": "restart", "attempt": attempt + 1,
+            "cause": cause.value, "first_rank": rank, "first_rc": rc,
+            "host": hosts[rank] if rank is not None else None,
+            "backoff_s": backoff, "resumed_step": resumed,
+            "elapsed_s": elapsed, "timed_out": timed_out,
+        })
+        metrics.count("supervisor.restarts")
+        metrics.count(f"supervisor.restarts.{cause.value}")
+        if resumed is not None:
+            metrics.gauge("supervisor.last_resumed_step", resumed)
+        print(f"harp_tpu.supervisor: attempt {attempt} failed "
+              f"({cause.value}, first rank {rank}, rc {rc}) — relaunching "
+              f"in {backoff:.1f}s"
+              + (f" from checkpoint step {resumed}" if resumed is not None
+                 else " from scratch (no checkpoint yet)"),
+              file=sys.stderr, flush=True)
+        sleep(backoff)
+        attempt += 1
+
+
+def _finish(metrics, metrics_path: Optional[str]) -> None:
+    if metrics_path:
+        metrics.dump(metrics_path)
+
+
+def _echo_member(i: int, rc: Optional[int], out: str,
+                 partial: bool = False) -> None:
+    tag = "partial, timed out" if partial else f"rc={rc}"
+    print(f"--- member {i} ({tag}) ---")
+    if out:
+        print(out, end="" if out.endswith("\n") else "\n")
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    import argparse
+
+    if "--" in argv:
+        split = argv.index("--")
+        argv, command = argv[:split], argv[split + 1:]
+    else:
+        command = []
+    p = argparse.ArgumentParser(prog="harp_tpu.parallel.supervisor")
+    p.add_argument("nodes", help="nodes file (the launch module's format)")
+    p.add_argument("--max-restarts", type=int, default=2)
+    p.add_argument("--backoff-base", type=float, default=1.0)
+    p.add_argument("--backoff-max", type=float, default=60.0)
+    p.add_argument("--timeout", type=float, default=1800.0,
+                   help="per-attempt gang deadline, seconds")
+    p.add_argument("--work-dir", default="",
+                   help="the job's work dir: checkpoint dir (work-dir/ckpt) "
+                        "for resumed-step journaling, restart journal and "
+                        "metrics land here")
+    p.add_argument("--journal", default="",
+                   help="restart journal path (default "
+                        "work-dir/restart_journal.jsonl)")
+    p.add_argument("--smoke", action="store_true",
+                   help="run the mp_smoke routine instead of a command")
+    args = p.parse_args(argv)
+    if args.smoke:
+        command = launch_mod.smoke_command()
+    elif not command:
+        print("no command given (use -- <command...> or --smoke)",
+              file=sys.stderr)
+        return 2
+    nodes = launch_mod.parse_nodes_file(args.nodes)
+    work = args.work_dir
+    journal = args.journal or (os.path.join(work, "restart_journal.jsonl")
+                               if work else None)
+    outcome = supervise(
+        nodes, command,
+        policy=RestartPolicy(max_restarts=args.max_restarts,
+                             backoff_base_s=args.backoff_base,
+                             backoff_max_s=args.backoff_max),
+        timeout=args.timeout,
+        checkpoint_dir=os.path.join(work, "ckpt") if work else None,
+        journal_path=journal,
+        metrics_path=(os.path.join(work, "supervisor_metrics.json")
+                      if work else None),
+        echo=True)
+    restarts = sum(1 for r in outcome.journal if r.get("event") == "restart")
+    status = "succeeded" if outcome.ok else f"gave up ({outcome.gave_up})"
+    print(f"harp_tpu.supervisor: {status} after {outcome.attempts} "
+          f"attempt(s), {restarts} restart(s)", file=sys.stderr)
+    if outcome.ok:
+        return 0
+    # surface the instigator's exit code (usage errors stay 2); signal
+    # deaths report negative — map to 1
+    rc = (outcome.results.first_failed_rc
+          if outcome.results is not None else None)
+    return rc if rc is not None and rc > 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,6 +25,13 @@ the last column), ``sgd_mf``/``als`` ``--ratings-file`` (COO), ``lda``
 ``--corpus-file``, ``subgraph`` ``--template-file`` — each takes a file,
 a directory of part-files, or a glob, local or ``scheme://`` remote
 (io.loaders.list_files).
+
+Fault tolerance: every subcommand accepts ``--max-restarts N`` — outside a
+gang the job re-execs under the elastic supervisor
+(parallel.supervisor) and a crash relaunches from the latest verified
+checkpoint; under the gang launcher the gang-level supervisor owns
+restarts. ``HARP_FAULT`` (parallel.faults) scripts deterministic faults at
+the checkpointed loops' iteration boundaries (README: Fault tolerance).
 """
 
 from __future__ import annotations
@@ -34,6 +41,7 @@ import dataclasses
 import os
 import sys
 import time
+from typing import Optional
 
 
 def _common_flags(p: argparse.ArgumentParser) -> None:
@@ -44,6 +52,12 @@ def _common_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--work-dir", default="",
                    help="output/checkpoint directory (optional)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--max-restarts", type=int, default=0,
+                   help="elastic supervision: on a crash, relaunch the job "
+                        "from the latest verified checkpoint up to N times "
+                        "(parallel.supervisor; restart journal lands in "
+                        "work-dir). Inside a gang this is handled by the "
+                        "gang-level supervisor and ignored here.")
 
 
 def _session(args):
@@ -1085,6 +1099,57 @@ COMMANDS = {
 }
 
 
+def _flag_value(argv, name):
+    """Last occurrence of ``--name V`` / ``--name=V`` in argv, or None."""
+    val = None
+    for i, tok in enumerate(argv):
+        if tok == name and i + 1 < len(argv):
+            val = argv[i + 1]
+        elif tok.startswith(name + "="):
+            val = tok.split("=", 1)[1]
+    return val
+
+
+def _maybe_self_supervise(argv) -> Optional[int]:
+    """``--max-restarts N`` outside a gang: re-exec this job under the
+    elastic supervisor (parallel.supervisor.supervise_local) so a crash —
+    scripted via HARP_FAULT or real — relaunches from the latest verified
+    checkpoint. Under a gang launcher (HARP_COORDINATOR) the gang-level
+    supervisor owns restarts; in the supervised child (HARP_SUPERVISED)
+    recursing would nest supervisors."""
+    try:
+        restarts = int(_flag_value(argv, "--max-restarts") or 0)
+    except ValueError:
+        return None                  # let the subcommand parser reject it
+    if restarts <= 0 or os.environ.get("HARP_COORDINATOR") \
+            or os.environ.get("HARP_SUPERVISED"):
+        return None
+    from harp_tpu.parallel import supervisor
+
+    work = _flag_value(argv, "--work-dir") or ""
+    outcome = supervisor.supervise_local(
+        [sys.executable, "-m", "harp_tpu.run"] + argv,
+        # no per-attempt deadline: an unsupervised run has none either, and
+        # a long legitimate fit must not be killed just because supervision
+        # was enabled (the gang CLI keeps the 1800 s default — there a hung
+        # MEMBER blocks the whole gang)
+        timeout=None,
+        policy=supervisor.RestartPolicy(max_restarts=restarts),
+        checkpoint_dir=os.path.join(work, "ckpt") if work else None,
+        journal_path=(os.path.join(work, "restart_journal.jsonl")
+                      if work else None),
+        metrics_path=(os.path.join(work, "supervisor_metrics.json")
+                      if work else None),
+        echo=True)
+    if outcome.ok:
+        return 0
+    # surface the child's own exit code (an argparse usage error must still
+    # exit 2 under supervision); signal deaths report negative — map to 1
+    rc = (outcome.results.first_failed_rc
+          if outcome.results is not None else None)
+    return rc if rc is not None and rc > 0 else 1
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
@@ -1096,6 +1161,9 @@ def main(argv=None) -> int:
         print(f"unknown subcommand {cmd!r}; choose from "
               f"{', '.join(sorted(COMMANDS))}", file=sys.stderr)
         return 2
+    supervised = _maybe_self_supervise(argv)
+    if supervised is not None:
+        return supervised
     return COMMANDS[cmd](argv[1:])
 
 

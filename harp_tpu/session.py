@@ -36,6 +36,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from harp_tpu import compat
 from harp_tpu.parallel import mesh as mesh_lib
 from harp_tpu.parallel.mesh import WORKERS
 
@@ -110,9 +111,8 @@ class HarpSession:
         call per collective, the whole iterative program is traced once and XLA
         schedules all collectives over ICI.
         """
-        mapped = jax.shard_map(
+        mapped = compat.shard_map(
             fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
-            check_vma=False,
         )
         return jax.jit(mapped, static_argnums=static_argnums,
                        donate_argnums=donate_argnums)

@@ -23,19 +23,132 @@ Usage::
 
 from __future__ import annotations
 
+import json
+import logging
 import os
 import re
-from typing import Any, Dict, Optional
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
-import jax
 import numpy as np
 
-try:
-    import orbax.checkpoint as _ocp
-    _HAVE_ORBAX = True
-except Exception:      # pragma: no cover - baked-in image has orbax
-    _ocp = None
-    _HAVE_ORBAX = False
+log = logging.getLogger("harp_tpu.checkpoint")
+
+# jax and orbax are imported LAZILY: the gang supervisor verifies checkpoints
+# (latest_valid_step(deep=False) → verify_step_dir) between relaunches, and
+# that path must stay numpy-only — the supervisor must never initialize a jax
+# backend (on TPU it would hold the accelerator against the relaunched gang)
+# just to CRC a file.
+_ORBAX_UNSET = object()
+_ocp_cached: Any = _ORBAX_UNSET
+
+
+def _orbax():
+    """orbax.checkpoint, imported on first use (None if unavailable)."""
+    global _ocp_cached
+    if _ocp_cached is _ORBAX_UNSET:
+        try:
+            import orbax.checkpoint as ocp
+            _ocp_cached = ocp
+        except Exception:  # pragma: no cover - baked-in image has orbax
+            _ocp_cached = None
+    return _ocp_cached
+
+
+MANIFEST = "manifest.json"
+
+
+def list_step_numbers(directory: str) -> List[int]:
+    """Step numbers under ``directory`` (``step_NNN`` dirs), ascending.
+
+    The single source of truth for the step-dir naming scheme — the
+    Checkpointer, the resume scanners and the fault injector
+    (``parallel.faults.corrupt_latest``) all go through here."""
+    out = []
+    if os.path.isdir(directory):
+        for name in os.listdir(directory):
+            m = re.fullmatch(r"step_(\d+)", name)
+            if m:
+                out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def _crc(arr) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
+def verify_step_dir(path: str, deep: bool = True) -> bool:
+    """True iff the step directory's manifest checks out (per-array CRC32 and
+    leaf count), or it predates manifests (legacy dirs carry none and stay
+    trusted). A torn or bit-flipped checkpoint — a member killed mid-fsync, a
+    flaky disk — verifies False instead of blowing up the resume path, so
+    restore falls back to the previous step. Works for both payload formats:
+    ``arrays.npz`` is checked leaf-by-leaf; an orbax payload is re-loaded and
+    its leaf CRCs compared as a multiset (orbax's restored container types
+    don't guarantee flatten order, but corruption flips bytes, not order).
+
+    ``deep=False`` skips the orbax re-load (the npz CRC check is cheap and
+    always runs): the gang supervisor journaling a resumed step must not
+    initialize a jax backend — on TPU that would hold the accelerator
+    against every relaunched child — or pay a full restore for an advisory
+    field. The tmp-dir-then-rename write already makes an orbax step dir's
+    existence prove completeness; the deep CRC re-load runs in the training
+    child before the state is trusted."""
+    man_path = os.path.join(path, MANIFEST)
+    if not os.path.exists(man_path):
+        return True
+    try:
+        with open(man_path) as f:
+            man = json.load(f)
+        npz = os.path.join(path, "arrays.npz")
+        if os.path.exists(npz):
+            with np.load(npz) as data:
+                if len(data.files) != man["leaves"]:
+                    return False
+                for i in range(man["leaves"]):
+                    if _crc(data[str(i)]) != man["arrays"][str(i)]["crc32"]:
+                        return False
+            return True
+        if not deep:
+            return True
+        if _orbax() is None:
+            return False
+        import jax
+
+        leaves = jax.tree.leaves(_orbax().PyTreeCheckpointer().restore(path))
+        return _leaves_match_manifest(man, leaves)
+    except Exception:
+        return False
+
+
+def _load_manifest(path: str) -> Optional[dict]:
+    """The step dir's manifest, or None when it predates manifests."""
+    man_path = os.path.join(path, MANIFEST)
+    if not os.path.exists(man_path):
+        return None
+    with open(man_path) as f:
+        return json.load(f)
+
+
+def _leaves_match_manifest(man: dict, leaves) -> bool:
+    """Leaf count + CRC32 multiset check (order-insensitive: orbax's restored
+    container types don't guarantee flatten order, but corruption flips
+    bytes, not order)."""
+    if len(leaves) != man["leaves"]:
+        return False
+    want = sorted(a["crc32"] for a in man["arrays"].values())
+    return sorted(_crc(leaf) for leaf in leaves) == want
+
+
+def latest_valid_step(directory: str, deep: bool = True) -> Optional[int]:
+    """Newest step under ``directory`` whose manifest verifies — usable
+    without constructing a Checkpointer. The gang supervisor reads this with
+    ``deep=False`` to journal the step a relaunch will resume from (see
+    :func:`verify_step_dir`)."""
+    for s in reversed(list_step_numbers(directory)):
+        if verify_step_dir(os.path.join(directory, f"step_{s:012d}"), deep):
+            return s
+    return None
 
 
 class Checkpointer:
@@ -50,11 +163,13 @@ class Checkpointer:
         # it, while the gang contract here is master-only writes of
         # replicated state (save() docstring) — an orbax master-only save
         # deadlocks in that internal sync
-        self.use_orbax = (use_orbax and _HAVE_ORBAX
+        import jax
+
+        self.use_orbax = (use_orbax and _orbax() is not None
                           and jax.process_count() == 1)
         os.makedirs(self.directory, exist_ok=True)
         if self.use_orbax:
-            self._ckptr = _ocp.PyTreeCheckpointer()
+            self._ckptr = _orbax().PyTreeCheckpointer()
         self._executor = None
         self._pending = None
         if async_save:
@@ -72,12 +187,7 @@ class Checkpointer:
         return self._list_steps()
 
     def _list_steps(self) -> list:
-        out = []
-        for name in os.listdir(self.directory):
-            m = re.fullmatch(r"step_(\d+)", name)
-            if m:
-                out.append(int(m.group(1)))
-        return sorted(out)
+        return list_step_numbers(self.directory)
 
     # -- save / restore ------------------------------------------------------
     def save(self, step: int, state: Any) -> str:
@@ -93,6 +203,8 @@ class Checkpointer:
         in-loop collectives keep members from racing past the chunk
         boundary while the master writes. Gang resume assumes the work dir
         is SHARED across members (the reference's HDFS assumption)."""
+        import jax
+
         path = self._step_dir(step)
         if jax.process_count() > 1 and jax.process_index() != 0:
             return path
@@ -111,28 +223,43 @@ class Checkpointer:
             pending.result()
 
     def _write(self, path: str, state: Any) -> None:
+        # Write into a tmp dir and rename: a fail-stop kill mid-write
+        # (elastic gang restart, r5) must never leave a step dir that lists
+        # as restorable but holds a torn payload — _list_steps only matches
+        # the final name, so a checkpoint EXISTS iff it is complete. The
+        # manifest (per-array CRC32s) then guarantees it is INTACT: resume
+        # skips a corrupt step (verify_step_dir) instead of crashing on it.
+        # Both payload formats get the same treatment — the numpy fallback
+        # stores leaves only (restore() needs `like` to rebuild the tree).
+        import jax
+
+        tmp = f"{path}.tmp-{os.getpid()}"
+        leaves, _ = jax.tree.flatten(state)
         if self.use_orbax:
-            self._ckptr.save(path, state, force=True)
+            self._ckptr.save(tmp, state, force=True)
         else:
-            # numpy fallback stores leaves only; restore() needs `like` to
-            # rebuild the tree structure. Write into a tmp dir and rename:
-            # a fail-stop kill mid-write (elastic gang restart, r5) must
-            # never leave a step dir that lists as restorable but holds a
-            # torn npz — _list_steps only matches the final name, so a
-            # checkpoint EXISTS iff it is complete
-            tmp = f"{path}.tmp-{os.getpid()}"
             os.makedirs(tmp, exist_ok=True)
-            leaves, _ = jax.tree.flatten(state)
             np.savez(os.path.join(tmp, "arrays.npz"),
                      **{str(i): leaf for i, leaf in enumerate(leaves)})
-            if os.path.isdir(path):      # re-save of the same step
-                import shutil
+        manifest = {
+            "leaves": len(leaves),
+            "arrays": {str(i): {"crc32": _crc(leaf),
+                                "shape": list(np.shape(leaf)),
+                                "dtype": str(np.asarray(leaf).dtype)}
+                       for i, leaf in enumerate(leaves)},
+        }
+        with open(os.path.join(tmp, MANIFEST), "w") as f:
+            json.dump(manifest, f)
+        if os.path.isdir(path):      # re-save of the same step
+            import shutil
 
-                shutil.rmtree(path)
-            os.replace(tmp, path)
+            shutil.rmtree(path)
+        os.replace(tmp, path)
         self._prune()
 
     def restore(self, step: int, like: Optional[Any] = None) -> Any:
+        import jax
+
         self.wait()
         path = self._step_dir(step)
         if self.use_orbax:
@@ -149,16 +276,112 @@ class Checkpointer:
             return self._ckptr.restore(path)
         data = np.load(os.path.join(path, "arrays.npz"))
         leaves = [data[str(i)] for i in range(len(data.files))]
-        if like is not None:
-            treedef = jax.tree.structure(like)
-            return jax.tree.unflatten(treedef, leaves)
-        return leaves
+        return self._unflatten(path, leaves, like)
+
+    def _require_leaf_count(self, path: str, count: int,
+                            like: Any) -> None:
+        import jax
+
+        want = jax.tree.structure(like).num_leaves
+        if count != want:
+            raise ValueError(
+                f"checkpoint {path} holds {count} arrays but the "
+                f"requested structure has {want} leaves — it was written "
+                f"for a different state shape (wrong work dir, or the "
+                f"model's state definition changed)")
+
+    def _unflatten(self, path: str, leaves: List, like: Optional[Any]) -> Any:
+        import jax
+
+        if like is None:
+            return leaves
+        self._require_leaf_count(path, len(leaves), like)
+        return jax.tree.unflatten(jax.tree.structure(like), leaves)
+
+    # -- integrity -----------------------------------------------------------
+    def verify(self, step: int) -> bool:
+        """Checksum-verify one step (manifest-less legacy dirs stay trusted)."""
+        self.wait()
+        return verify_step_dir(self._step_dir(step))
+
+    def valid_steps(self) -> List[int]:
+        """Steps that verify, oldest first; logs (once per call) the corrupt
+        ones being passed over. NOTE: verifies EVERY retained step — and for
+        orbax payloads each verification is a full restore. Resume paths
+        want :meth:`latest_valid_step` (newest-first, stops at the first
+        step that verifies); this full scan is for diagnostics/tests."""
+        out = []
+        for s in self.steps():
+            if verify_step_dir(self._step_dir(s)):
+                out.append(s)
+            else:
+                log.warning("checkpoint step %d fails manifest verification "
+                            "— skipping it for resume", s)
+        return out
+
+    def latest_valid_step(self) -> Optional[int]:
+        """Newest step that verifies, scanning newest-first so a resume pays
+        for ONE verification in the common all-healthy case (a torn/corrupt
+        newest checkpoint costs one save interval, not the whole run)."""
+        self.wait()
+        for s in reversed(self._list_steps()):
+            if verify_step_dir(self._step_dir(s)):
+                return s
+            log.warning("checkpoint step %d fails manifest verification "
+                        "— skipping it for resume", s)
+        return None
+
+    def restore_latest_valid(self, like: Optional[Any] = None
+                             ) -> Tuple[Optional[int], Optional[Any]]:
+        """``(step, state)`` of the newest step whose payload verifies,
+        reading each candidate payload ONCE — ``latest_valid_step()``
+        followed by ``restore()`` reads the newest checkpoint twice (for
+        orbax, two full restores), doubling resume I/O in the common
+        all-healthy case. Corrupt/torn/unreadable steps are logged and
+        skipped for the previous one; manifest-less legacy steps restore
+        untested. ``(None, None)`` when nothing usable exists."""
+        import jax
+
+        self.wait()
+        for s in reversed(self._list_steps()):
+            path = self._step_dir(s)
+            try:
+                man = _load_manifest(path)
+            except Exception as e:
+                log.warning("checkpoint step %d has an unreadable manifest "
+                            "(%r) — skipping it for resume", s, e)
+                continue
+            if man is not None and like is not None:
+                # BEFORE the restore try-block: a structure mismatch must
+                # raise the clear ValueError, not be swallowed as corruption
+                # and silently skipped (which would retrain from scratch)
+                self._require_leaf_count(path, man["leaves"], like)
+            try:
+                if self.use_orbax:
+                    state = self.restore(s, like=like)
+                    leaves = jax.tree.leaves(state)
+                else:
+                    with np.load(os.path.join(path, "arrays.npz")) as data:
+                        leaves = [data[str(i)]
+                                  for i in range(len(data.files))]
+                    state = None        # unflatten after verification
+            except Exception as e:
+                log.warning("checkpoint step %d failed to load (%r) — "
+                            "skipping it for resume", s, e)
+                continue
+            if man is not None and not _leaves_match_manifest(man, leaves):
+                log.warning("checkpoint step %d fails manifest verification "
+                            "— skipping it for resume", s)
+                continue
+            if state is None:
+                # AFTER verification so a structure mismatch raises the
+                # clear ValueError instead of being skipped as corruption
+                state = self._unflatten(path, leaves, like)
+            return s, state
+        return None, None
 
     def restore_latest(self, like: Optional[Any] = None) -> Optional[Any]:
-        steps = self.steps()
-        if not steps:
-            return None
-        return self.restore(steps[-1], like=like)
+        return self.restore_latest_valid(like=like)[1]
 
     def latest_step(self) -> Optional[int]:
         steps = self.steps()

@@ -11,12 +11,12 @@ introspection replacing the JVM calls.
 from __future__ import annotations
 
 import contextlib
+import json
 import logging
+import os
 import time
 from collections import defaultdict
 from typing import Dict
-
-import jax
 
 log = logging.getLogger("harp_tpu")
 
@@ -62,6 +62,14 @@ class Metrics:
             "timers": {k: self.timing(k) for k in self.timers},
         }
 
+    def dump(self, path: str) -> None:
+        """Persist a snapshot as JSON (the supervisor drops one next to its
+        restart journal so recovery counters survive the process)."""
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.snapshot(), f, indent=2, sort_keys=True)
+
     def log_summary(self) -> None:
         for name, t in sorted(self.timers.items()):
             s = self.timing(name)
@@ -77,6 +85,9 @@ DEFAULT = Metrics()
 def log_device_mem_usage() -> Dict[str, int]:
     """Device-memory introspection (replaces CollectiveMapper.logMemUsage:686 /
     logGCTime:696 — there is no GC on the device; HBM stats stand in)."""
+    import jax           # deferred: registry users (the gang supervisor) must
+    #                      not pay a backend init just to count restarts
+
     out = {}
     for d in jax.devices():
         try:

@@ -42,3 +42,6 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "large: larger-scale behavior tests (~1 min total); "
         "deselect with -m 'not large'")
+    config.addinivalue_line(
+        "markers", "slow: multi-process gang relaunch tests (minutes); "
+        "excluded from tier-1 (-m 'not slow')")

@@ -1,0 +1,548 @@
+"""Elastic gang supervisor: deterministic fault injection, classify/backoff/
+journal policy, verified-checkpoint resume.
+
+Reference parity (SURVEY §5): the reference's failure handling ENDED at
+detection — "Slaves may fail" (Communication.java:82) and the job died, with
+workers never re-executed. These tests cover the recovery half the reference
+never had: scripted member death (parallel.faults) → gang fail-stop → the
+supervisor (parallel.supervisor) relaunches from the newest checksum-verified
+checkpoint → the finished model is bitwise what an uninterrupted run produces.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from harp_tpu.parallel import failure, faults, launch, supervisor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _nodes(n):
+    return [launch.Node("localhost", 0) for _ in range(n)]
+
+
+def _journal(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+# --------------------------------------------------------------------------- #
+# fault grammar + firing semantics
+# --------------------------------------------------------------------------- #
+
+def test_fault_grammar_roundtrip():
+    specs = faults.parse_faults(
+        "crash@epoch=3:rank=1, hang@epoch=2, "
+        "ckpt-corrupt@epoch=4:rank=0:attempt=1")
+    assert specs == [
+        faults.FaultSpec("crash", 3, 1, 0),
+        faults.FaultSpec("hang", 2, None, 0),
+        faults.FaultSpec("ckpt-corrupt", 4, 0, 1),
+    ]
+
+
+@pytest.mark.parametrize("bad", [
+    "explode@epoch=1",            # unknown kind
+    "crash@rank=1",               # missing epoch
+    "crash epoch=1",              # no @
+    "crash@epoch=three",          # non-integer
+    "crash@epoch=1:node=2",       # unknown key
+])
+def test_fault_grammar_rejects(bad):
+    with pytest.raises(ValueError):
+        faults.parse_faults(bad)
+
+
+def test_fault_bad_spec_raises_on_every_boundary(monkeypatch):
+    # a malformed plan must fail EVERY fire(), not just the first — a caught
+    # first error must not install a stale/empty plan that silently disarms
+    # the scripted fault
+    monkeypatch.setenv("HARP_FAULT", "crash@epoch=oops")
+    with pytest.raises(ValueError):
+        faults.fire(1)
+    with pytest.raises(ValueError):
+        faults.fire(2)
+
+
+def test_fault_fire_rank_and_attempt_gating(monkeypatch):
+    fired = []
+    monkeypatch.setattr(faults, "_execute",
+                        lambda spec, ckpt: fired.append(spec.kind))
+    monkeypatch.setenv("HARP_FAULT", "crash@epoch=3:rank=1")
+    monkeypatch.setenv("HARP_PROCESS_ID", "0")
+    faults.fire(5)
+    assert fired == []                       # wrong rank never fires
+    monkeypatch.setenv("HARP_PROCESS_ID", "1")
+    faults.fire(2)
+    assert fired == []                       # epoch not reached yet
+    monkeypatch.setenv("HARP_GANG_ATTEMPT", "1")
+    faults.fire(3)
+    assert fired == []                       # relaunched attempt: disarmed
+    monkeypatch.setenv("HARP_GANG_ATTEMPT", "0")
+    faults.fire(3)
+    faults.fire(4)
+    assert fired == ["crash"]                # fires exactly once
+
+
+def test_fault_crash_kills_a_real_process(tmp_path):
+    # end-to-end through a subprocess (faults must not need jax): the hook
+    # at an "iteration boundary" exits with the scripted code
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "from harp_tpu.parallel import faults\n"
+         "for epoch in range(1, 6):\n"
+         "    faults.fire(epoch)\n"
+         "print('survived')"],
+        env={**os.environ, "HARP_FAULT": "crash@epoch=3:rank=0"},
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == faults.FAULT_CRASH_EXIT
+    assert "survived" not in proc.stdout
+
+
+def test_fault_ckpt_corrupt_targets_newest_step(tmp_path):
+    from harp_tpu.utils.checkpoint import Checkpointer, latest_valid_step
+
+    ck = Checkpointer(str(tmp_path), use_orbax=False, keep=5)
+    for s in (1, 2):
+        ck.save(s, {"w": np.full((3, 3), float(s))})
+    assert faults.corrupt_latest(str(tmp_path)).endswith(
+        os.path.join("step_000000000002", "arrays.npz"))
+    assert latest_valid_step(str(tmp_path)) == 1
+
+
+# --------------------------------------------------------------------------- #
+# launcher: first-failure attribution + partial output on timeout (satellite)
+# --------------------------------------------------------------------------- #
+
+def test_launch_reports_first_failing_member():
+    cmd = [sys.executable, "-c",
+           "import os, sys, time\n"
+           "if os.environ['HARP_PROCESS_ID'] == '1':\n"
+           "    time.sleep(0.2); sys.exit(7)\n"
+           "time.sleep(120)"]
+    results = launch.launch(_nodes(3), cmd, timeout=60.0)
+    assert not results.ok
+    assert results.first_failure == (1, 7)
+    assert results.first_failed_rank == 1 and results.first_failed_rc == 7
+    # survivors were killed, but are NOT blamed
+    assert results[0][0] != 0 and results[2][0] != 0
+
+
+def test_launch_clean_gang_has_no_first_failure():
+    results = launch.launch(_nodes(2), [sys.executable, "-c", "print('hi')"],
+                            timeout=60.0)
+    assert results.ok and results.first_failure is None
+
+
+def test_launch_timeout_carries_partial_output():
+    cmd = [sys.executable, "-c",
+           "import os, sys, time\n"
+           "print('rank', os.environ['HARP_PROCESS_ID'], 'starting',"
+           " flush=True)\n"
+           "time.sleep(120)"]
+    with pytest.raises(subprocess.TimeoutExpired) as ei:
+        launch.launch(_nodes(2), cmd, timeout=3.0)
+    outs = ei.value.member_outputs
+    assert len(outs) == 2
+    assert "rank 0 starting" in outs[0] and "rank 1 starting" in outs[1]
+    assert "rank 0 starting" in ei.value.output
+
+
+# --------------------------------------------------------------------------- #
+# supervisor policy: classify, backoff, budget, suspect node, journal
+# --------------------------------------------------------------------------- #
+
+def test_classify_watchdog_vs_crash():
+    crash = launch.GangResult([(0, ""), (9, "")], first_failure=(1, 9))
+    wd = launch.GangResult([(98, ""), (-9, "")], first_failure=(0, 98))
+    clean = launch.GangResult([(0, ""), (0, "")])
+    assert supervisor.classify(crash)[0] is supervisor.FailureClass.CRASH
+    assert supervisor.classify(wd) == (supervisor.FailureClass.WATCHDOG, 0, 98)
+    assert supervisor.classify(clean)[0] is supervisor.FailureClass.CLEAN
+
+
+def test_policy_backoff_is_exponential_and_capped():
+    pol = supervisor.RestartPolicy(backoff_base_s=1.0, backoff_factor=2.0,
+                                   backoff_max_s=5.0)
+    assert [pol.backoff(i) for i in range(4)] == [1.0, 2.0, 4.0, 5.0]
+
+
+def test_supervise_budget_exhausted_keeps_journal(tmp_path):
+    journal_path = str(tmp_path / "journal.jsonl")
+    sleeps = []
+    out = supervisor.supervise(
+        _nodes(2),
+        [sys.executable, "-c",
+         "import os, sys, time\n"
+         "if os.environ['HARP_PROCESS_ID'] == '0':\n"
+         "    sys.exit(7)\n"
+         "time.sleep(120)"],
+        policy=supervisor.RestartPolicy(max_restarts=2),
+        timeout=60.0, journal_path=journal_path, sleep=sleeps.append)
+    assert not out.ok and out.gave_up == "budget" and out.attempts == 3
+    assert sleeps == [1.0, 2.0]               # exponential schedule honored
+    records = _journal(journal_path)
+    restarts = [r for r in records if r["event"] == "restart"]
+    assert len(restarts) == 2
+    assert all(r["cause"] == "crash" and r["first_rank"] == 0
+               and r["first_rc"] == 7 for r in restarts)
+    assert records[-1]["event"] == "give-up"
+
+
+def test_supervise_recovers_from_transient_crash(tmp_path):
+    # the member keys on HARP_GANG_ATTEMPT exactly like the fault layer:
+    # dead on attempt 0, clean on the relaunch
+    from harp_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    out = supervisor.supervise(
+        _nodes(2),
+        [sys.executable, "-c",
+         "import os, sys\n"
+         "sys.exit(5 if os.environ['HARP_GANG_ATTEMPT'] == '0' else 0)"],
+        policy=supervisor.RestartPolicy(max_restarts=2),
+        timeout=60.0, metrics=m, sleep=lambda s: None)
+    assert out.ok and out.attempts == 2
+    assert m.counters["supervisor.restarts"] == 1
+    assert m.counters["supervisor.recoveries"] == 1
+    assert [r["event"] for r in out.journal] == ["restart", "success"]
+
+
+def test_supervise_marks_repeat_watchdog_node_suspect(tmp_path):
+    # rank 1 exits with the watchdog code on EVERY attempt: after
+    # watchdog_suspect_after deaths the supervisor stops burning budget
+    journal_path = str(tmp_path / "journal.jsonl")
+    out = supervisor.supervise(
+        _nodes(2),
+        [sys.executable, "-c",
+         "import os, sys, time\n"
+         "if os.environ['HARP_PROCESS_ID'] == '1':\n"
+         "    sys.exit(98)\n"
+         "time.sleep(120)"],
+        policy=supervisor.RestartPolicy(max_restarts=10,
+                                        watchdog_suspect_after=2),
+        timeout=60.0, journal_path=journal_path, sleep=lambda s: None)
+    assert not out.ok and out.gave_up == "suspect-node"
+    assert out.attempts == 2                  # not 11: aborted early
+    records = _journal(journal_path)
+    assert records[-1]["event"] == "abort-suspect"
+    assert records[-1]["first_rank"] == 1
+    assert records[-1]["host"] == "localhost"
+
+
+def test_supervise_aborts_on_non_retryable_exit(tmp_path):
+    # argparse usage errors (rc=2) fail identically every attempt: the
+    # supervisor must not burn the budget relaunching them
+    out = supervisor.supervise(
+        _nodes(2), [sys.executable, "-c", "import sys; sys.exit(2)"],
+        policy=supervisor.RestartPolicy(max_restarts=5),
+        timeout=60.0, sleep=lambda s: None)
+    assert not out.ok and out.gave_up == "non-retryable"
+    assert out.attempts == 1                  # no relaunch at all
+    assert out.journal[-1]["event"] == "abort-non-retryable"
+
+
+def test_supervise_classifies_gang_timeout(tmp_path):
+    out = supervisor.supervise(
+        _nodes(2), [sys.executable, "-c", "import time; time.sleep(120)"],
+        policy=supervisor.RestartPolicy(max_restarts=1),
+        timeout=2.0, sleep=lambda s: None)
+    assert not out.ok and out.gave_up == "budget"
+    restarts = [r for r in out.journal if r["event"] == "restart"]
+    assert restarts and restarts[0]["cause"] == "timeout"
+    assert restarts[0]["timed_out"] is True
+
+
+# --------------------------------------------------------------------------- #
+# checkpoint integrity: manifest checksums + clear structural errors
+# --------------------------------------------------------------------------- #
+
+def test_corrupt_latest_checkpoint_falls_back_to_previous(tmp_path):
+    from harp_tpu.utils import checkpoint as ck
+
+    c = ck.Checkpointer(str(tmp_path), use_orbax=False, keep=5)
+    like = {"w": np.zeros((4, 2)), "b": np.zeros(3)}
+    for s in (1, 2, 3):
+        c.save(s, {"w": np.full((4, 2), float(s)), "b": np.arange(3.) * s})
+    faults.corrupt_latest(str(tmp_path))
+    assert c.steps() == [1, 2, 3]             # the dir still lists it...
+    assert c.valid_steps() == [1, 2]          # ...but it no longer verifies
+    restored = c.restore_latest(like=like)
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.full((4, 2), 2.0))
+    assert ck.latest_valid_step(str(tmp_path)) == 2
+
+
+def test_corrupt_orbax_checkpoint_falls_back(tmp_path):
+    # the DEFAULT checkpoint format (run.py's) must carry the same manifest
+    # guarantee as the numpy fallback: corrupt newest payload -> skipped
+    from harp_tpu.utils import checkpoint as ck
+
+    if ck._orbax() is None:
+        pytest.skip("orbax not installed")
+    c = ck.Checkpointer(str(tmp_path))
+    assert c.use_orbax
+    like = {"w": np.zeros((8, 4))}
+    for s in (1, 2):
+        c.save(s, {"w": np.full((8, 4), float(s))})
+    assert c.valid_steps() == [1, 2]
+    damaged = faults.corrupt_latest(str(tmp_path))
+    assert damaged is not None and "step_000000000002" in damaged
+    assert not damaged.endswith("manifest.json")
+    assert c.valid_steps() == [1]
+    restored = c.restore_latest(like=like)
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.full((8, 4), 1.0))
+    assert ck.latest_valid_step(str(tmp_path)) == 1
+    # the supervisor's journaling scan (deep=False) must not pay an orbax
+    # re-load per step: an orbax dir's existence counts as complete (the
+    # child re-verifies deeply), while npz payloads still CRC-check
+    assert ck.latest_valid_step(str(tmp_path), deep=False) == 2
+
+
+def test_shallow_scan_still_crc_checks_npz(tmp_path):
+    # the gang wire format is npz — the supervisor's deep=False journal scan
+    # keeps full CRC verification there (cheap, numpy-only), so the journaled
+    # resumed_step matches what the relaunched gang actually resumes from
+    from harp_tpu.utils import checkpoint as ck
+
+    c = ck.Checkpointer(str(tmp_path), use_orbax=False, keep=5)
+    for s in (1, 2):
+        c.save(s, {"w": np.full((4, 4), float(s))})
+    faults.corrupt_latest(str(tmp_path))
+    assert ck.latest_valid_step(str(tmp_path), deep=False) == 1
+
+
+def test_truncated_npz_fails_verification(tmp_path):
+    from harp_tpu.utils import checkpoint as ck
+
+    c = ck.Checkpointer(str(tmp_path), use_orbax=False)
+    c.save(1, {"w": np.ones((64, 64))})
+    npz = tmp_path / "step_000000000001" / "arrays.npz"
+    npz.write_bytes(npz.read_bytes()[:100])   # torn write
+    assert not c.verify(1)
+    assert c.valid_steps() == []
+    assert c.restore_latest(like={"w": np.zeros((64, 64))}) is None
+
+
+def test_restore_numpy_leaf_count_mismatch_is_clear(tmp_path):
+    from harp_tpu.utils.checkpoint import Checkpointer
+
+    c = Checkpointer(str(tmp_path), use_orbax=False)
+    c.save(1, {"w": np.ones(2), "b": np.zeros(2)})
+    with pytest.raises(ValueError, match="2 arrays.*3 leaves"):
+        c.restore(1, like={"w": np.zeros(2), "b": np.zeros(2),
+                           "extra": np.zeros(1)})
+
+
+@pytest.mark.parametrize("use_orbax", [False, True])
+def test_restore_latest_valid_mismatch_raises_not_skips(tmp_path, use_orbax):
+    # a state-shape mismatch must raise the clear error, NOT be classified
+    # as corruption and skipped (which would silently retrain from scratch
+    # and eventually prune the old checkpoints)
+    from harp_tpu.utils import checkpoint as ck
+
+    if use_orbax and ck._orbax() is None:
+        pytest.skip("orbax not installed")
+    c = ck.Checkpointer(str(tmp_path), use_orbax=use_orbax)
+    c.save(1, {"w": np.ones(2), "b": np.zeros(2)})
+    with pytest.raises(ValueError, match="2 arrays.*3 leaves"):
+        c.restore_latest_valid(like={"w": np.zeros(2), "b": np.zeros(2),
+                                     "extra": np.zeros(1)})
+
+
+# --------------------------------------------------------------------------- #
+# failure-detection satellites: probe hygiene + watchdog without handler
+# --------------------------------------------------------------------------- #
+
+def test_probe_threads_are_named_and_capped(monkeypatch):
+    import jax
+
+    # a device_put that "hangs" long past the probe deadline (the returned
+    # None then errors in the probe thread, which just marks it poisoned)
+    monkeypatch.setattr(jax, "device_put", lambda *a, **k: time.sleep(2.0))
+    monkeypatch.setattr(failure, "_orphan_probes", set())
+    t0 = time.monotonic()
+    for _ in range(failure.MAX_ORPHAN_PROBES):
+        assert failure.probe_devices(timeout_s=0.01) is False
+    names = [t.name for t in threading.enumerate()
+             if t.name.startswith("harp-probe-")]
+    assert len(names) == failure.MAX_ORPHAN_PROBES
+    # cap reached: fails fast with NO new thread
+    assert failure.probe_devices(timeout_s=10.0) is False
+    assert time.monotonic() - t0 < 5.0
+    assert len([t for t in threading.enumerate()
+                if t.name.startswith("harp-probe-")]) == \
+        failure.MAX_ORPHAN_PROBES
+
+
+def test_watchdog_keeps_probing_when_no_handler():
+    calls = []
+
+    def probe(timeout_s):
+        calls.append(1)
+        return False
+
+    wd = failure.Watchdog(interval_s=0.01, timeout_s=0.01, on_failure=None,
+                          probe=probe)
+    wd.start()
+    deadline = time.monotonic() + 5.0
+    while len(calls) < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert wd._thread.is_alive()              # did not silently stop
+    wd.stop()
+    assert len(calls) >= 3 and wd.failed
+    with pytest.raises(failure.WorkerFailure):
+        wd.ok()
+
+
+def test_watchdog_handler_path_still_stops():
+    hits = []
+    wd = failure.Watchdog(interval_s=0.01, timeout_s=0.01,
+                          on_failure=lambda: hits.append(1),
+                          probe=lambda t: False)
+    wd.start()
+    deadline = time.monotonic() + 5.0
+    while not hits and time.monotonic() < deadline:
+        time.sleep(0.01)
+    wd.stop()
+    assert hits == [1]                        # fired once, then stopped
+
+
+# --------------------------------------------------------------------------- #
+# end-to-end: scripted fault -> supervised relaunch -> verified bitwise resume
+# --------------------------------------------------------------------------- #
+
+def _km_cmd(work, iterations=4, extra=()):
+    return [sys.executable, "-m", "harp_tpu.run", "kmeans", "--cpu-mesh",
+            "--num-workers", "1", "--num-points", "64", "--num-centroids",
+            "2", "--dim", "4", "--iterations", str(iterations),
+            "--work-dir", str(work), "--save-every", "1", *extra]
+
+
+def test_selfsupervised_fault_run_smoke(tmp_path):
+    """Tier-1 smoke: single-process job, scripted crash at epoch 3, one
+    supervised relaunch, final model bitwise-equal to an unfaulted run."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("HARP_FAULT", None)
+    ref = subprocess.run(_km_cmd(tmp_path / "ref"), env=env, cwd=REPO,
+                         capture_output=True, text=True, timeout=300)
+    assert ref.returncode == 0, ref.stdout + ref.stderr
+
+    work = tmp_path / "faulted"
+    proc = subprocess.run(
+        _km_cmd(work, extra=["--max-restarts", "2"]),
+        env={**env, "HARP_FAULT": "crash@epoch=3:rank=0"}, cwd=REPO,
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert (work / "centroids.csv").read_bytes() == \
+        (tmp_path / "ref" / "centroids.csv").read_bytes()
+    restarts = [r for r in _journal(work / "restart_journal.jsonl")
+                if r["event"] == "restart"]
+    assert len(restarts) == 1
+    assert restarts[0]["first_rank"] == 0
+    assert restarts[0]["first_rc"] == faults.FAULT_CRASH_EXIT
+    assert restarts[0]["resumed_step"] == 2   # crash BEFORE epoch 3 ran
+    metrics = json.load(open(work / "supervisor_metrics.json"))
+    assert metrics["counters"]["supervisor.recoveries"] == 1
+
+
+def test_selfsupervised_usage_error_exits_2(tmp_path):
+    # a usage error is non-retryable AND its exit code must survive
+    # supervision (scripts distinguish rc 2 from job failure rc 1)
+    proc = subprocess.run(
+        [sys.executable, "-m", "harp_tpu.run", "kmeans",
+         "--max-restarts", "2", "--bogus-flag"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO,
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 2
+
+
+@pytest.mark.slow
+def test_gang_supervisor_acceptance_bitwise(tmp_path):
+    """The ISSUE acceptance scenario: HARP_FAULT=crash@epoch=3:rank=1 on a
+    2-process gang kmeans job, --save-every 1 --max-restarts 2 — completes
+    via ONE supervisor relaunch, centroids bitwise-equal to the unfaulted
+    gang run, journal records the failing rank and resumed step."""
+    def gang_km(work):
+        return [sys.executable, "-m", "harp_tpu.run", "kmeans", "--cpu-mesh",
+                "--num-workers", "2", "--num-points", "512",
+                "--num-centroids", "4", "--dim", "8", "--iterations", "8",
+                "--work-dir", str(work), "--save-every", "1"]
+
+    ref_work = tmp_path / "ref"
+    results = launch.launch(_nodes(2), gang_km(ref_work), timeout=420.0,
+                            cwd=REPO)
+    assert results.ok, list(results)
+
+    work = tmp_path / "faulted"
+    env_backup = os.environ.get("HARP_FAULT")
+    os.environ["HARP_FAULT"] = "crash@epoch=3:rank=1"
+    try:
+        out = supervisor.supervise(
+            _nodes(2), gang_km(work),
+            policy=supervisor.RestartPolicy(max_restarts=2),
+            timeout=420.0, cwd=REPO,
+            checkpoint_dir=str(work / "ckpt"),
+            journal_path=str(work / "restart_journal.jsonl"))
+    finally:
+        if env_backup is None:
+            os.environ.pop("HARP_FAULT", None)
+        else:
+            os.environ["HARP_FAULT"] = env_backup
+    assert out.ok and out.attempts == 2
+    assert (work / "centroids.csv").read_bytes() == \
+        (ref_work / "centroids.csv").read_bytes()
+    restarts = [r for r in _journal(work / "restart_journal.jsonl")
+                if r["event"] == "restart"]
+    assert len(restarts) == 1
+    assert restarts[0]["first_rank"] == 1
+    assert restarts[0]["first_rc"] == faults.FAULT_CRASH_EXIT
+    assert restarts[0]["resumed_step"] == 2
+
+
+@pytest.mark.slow
+def test_gang_supervisor_corrupt_checkpoint_resume(tmp_path):
+    """Corrupt-then-crash plan: epoch 2's checkpoint is damaged before the
+    crash, so the relaunch resumes from step 1 (manifest fallback) and still
+    finishes bitwise-identical."""
+    def gang_km(work):
+        return [sys.executable, "-m", "harp_tpu.run", "kmeans", "--cpu-mesh",
+                "--num-workers", "2", "--num-points", "256",
+                "--num-centroids", "4", "--dim", "8", "--iterations", "6",
+                "--work-dir", str(work), "--save-every", "1"]
+
+    ref_work = tmp_path / "ref"
+    assert launch.launch(_nodes(2), gang_km(ref_work), timeout=420.0,
+                         cwd=REPO).ok
+
+    work = tmp_path / "faulted"
+    env_backup = os.environ.get("HARP_FAULT")
+    os.environ["HARP_FAULT"] = \
+        "ckpt-corrupt@epoch=2:rank=0,crash@epoch=3:rank=1"
+    try:
+        out = supervisor.supervise(
+            _nodes(2), gang_km(work),
+            policy=supervisor.RestartPolicy(max_restarts=2),
+            timeout=420.0, cwd=REPO,
+            checkpoint_dir=str(work / "ckpt"),
+            journal_path=str(work / "restart_journal.jsonl"))
+    finally:
+        if env_backup is None:
+            os.environ.pop("HARP_FAULT", None)
+        else:
+            os.environ["HARP_FAULT"] = env_backup
+    assert out.ok
+    assert (work / "centroids.csv").read_bytes() == \
+        (ref_work / "centroids.csv").read_bytes()
+    restarts = [r for r in _journal(work / "restart_journal.jsonl")
+                if r["event"] == "restart"]
+    assert len(restarts) == 1
+    assert restarts[0]["resumed_step"] == 1   # step 2 was corrupt
