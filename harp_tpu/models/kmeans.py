@@ -40,7 +40,7 @@ import numpy as np
 
 from harp_tpu import combiner as cb
 from harp_tpu.collectives import lax_ops, rotation, table_ops
-from harp_tpu.ops import distance, pallas_kernels
+from harp_tpu.ops import distance, lane_pack, pallas_kernels
 from harp_tpu.session import HarpSession
 from harp_tpu.table import Table
 
@@ -57,6 +57,21 @@ class KMeansConfig:
     iterations: int = 10
     comm: str = "regroupallgather"
     compute_dtype: str = "float32"   # "bfloat16": bf16 matmuls, f32 accumulate
+    lane_pad: bool = True   # pad K to an lcm(128, W) multiple and D to a 128
+    #   multiple (ops/lane_pack) so the E-step's distance/stats GEMMs and the
+    #   (N, K) one-hot run on FULL 128-lane MXU tiles instead of e.g.
+    #   100-wide ones (the flagship measured 28% MFU on 100-wide tiles, r5:
+    #   ~1.3× left in lane padding) and operand reads stay lane-aligned.
+    #   Phantom centroid rows are zero, masked out of every argmin (+inf
+    #   score columns — no point can assign to padding) and average to zero;
+    #   phantom feature columns are zero (exact no-ops in scores and sums).
+    #   Numerics: the wider GEMM lets XLA re-tile the D-reduction, so scores
+    #   shift by ulps vs lane_pad=False — a NEAR-TIE assignment can flip and
+    #   fork the trajectory (measured: identical to 1.8e-7 for 3 iters, then
+    #   one flip; converged cost equal to 7 digits). Same epsilon class as
+    #   compute_dtype="bfloat16"'s documented flips. Cross-VARIANT bit
+    #   identity is unaffected (every variant shares the padded formulation).
+    #   Off: the pre-r6 worker-multiple-only padding.
 
 
 class KMeans:
@@ -72,15 +87,30 @@ class KMeans:
     def _build(self):
         sess, cfg = self.session, self.config
         w = sess.num_workers
-        k_pad = Table.local(jnp.zeros((cfg.num_centroids, 1)), num_workers=w).num_partitions
+        # stat-table partition count: always a worker multiple (Table
+        # contract); with lane_pad additionally an MXU-lane multiple, and the
+        # feature axis a 128 multiple, so the E-step's score GEMM, one-hot
+        # and stats GEMM all run on full 128-lane tiles (ops/lane_pack —
+        # phantom centroid rows are masked from every argmin and average to
+        # zero, phantom feature columns are exact zero no-ops)
+        if cfg.lane_pad:
+            k_pad = lane_pack.lane_target(cfg.num_centroids, divisor=w)
+            d_pad = lane_pack.round_up(cfg.dim, lane_pack.LANES)
+        else:
+            k_pad = Table.local(jnp.zeros((cfg.num_centroids, 1)),
+                                num_workers=w).num_partitions
+            d_pad = cfg.dim
+        self._k_pad, self._d_pad = k_pad, d_pad
 
         cdtype = None if cfg.compute_dtype == "float32" else jnp.dtype(
             cfg.compute_dtype)
 
         def estep(points, centroids, x_sq_sum=None):
-            # dispatches to the fused pallas kernel when HARP_USE_PALLAS=1
+            # dispatches to the fused pallas kernel when HARP_USE_PALLAS=1;
+            # centroids carry k_pad rows, valid_k masks the phantoms
             sums, counts, sq = pallas_kernels.kmeans_stats(
-                points, centroids, compute_dtype=cdtype, x_sq_sum=x_sq_sum)
+                points, centroids, compute_dtype=cdtype, x_sq_sum=x_sq_sum,
+                valid_k=cfg.num_centroids)
             stats = jnp.concatenate([sums, counts[:, None]], axis=1)  # (K, D+1)
             return stats, sq
 
@@ -88,6 +118,8 @@ class KMeans:
             return stats[:, :-1] / jnp.maximum(stats[:, -1:], 1.0)
 
         def iter_body(centroids, points, x_sq_sum=None):
+            # centroids: (k_pad, d_pad) — phantom rows ride the collectives
+            # (zero counts → average 0) and are trimmed once, at fit_fn exit
             if cfg.comm == "rotation":
                 new_c, sq = self._rotation_iter(points, centroids, k_pad, w,
                                                 x_sq_sum, cdtype)
@@ -99,58 +131,59 @@ class KMeans:
                 # KMeansCollectiveMapper :168-189: regroup → average own block → allgather
                 g = table_ops.regroup(local)
                 own = average(g.data)
-                new_c = lax_ops.allgather(own)[: cfg.num_centroids]
+                new_c = lax_ops.allgather(own)
             elif cfg.comm == "allreduce":
                 full = table_ops.allreduce(local)
-                new_c = average(full.data)[: cfg.num_centroids]
+                new_c = average(full.data)
             elif cfg.comm == "pushpull":
                 zero = Table.sharded(
                     jnp.zeros((k_pad // w,) + stats.shape[1:]), num_workers=w)
                 g = table_ops.push(local, zero)
                 pulled = table_ops.pull(g)
-                new_c = average(pulled.data)[: cfg.num_centroids]
+                new_c = average(pulled.data)
             else:  # bcastreduce
                 red = table_ops.reduce(local, root=0)
                 own = average(red.data)
                 new_c = table_ops.broadcast(
-                    Table.local(own, num_workers=w), root=0).data[: cfg.num_centroids]
+                    Table.local(own, num_workers=w), root=0).data
             cost = jax.lax.psum(sq, lax_ops.WORKERS)
             return new_c, cost
 
         def fit_fn(points, centroids0):
-            pad = k_pad - cfg.num_centroids
-            cen = jnp.pad(centroids0, ((0, pad), (0, 0))) if pad else centroids0
+            # points arrive feature-padded from prepare(); pad again here so
+            # a raw fit_prepared(points, ·) call stays correct (no-op on
+            # prepared arrays). Centroids pad to the full (k_pad, d_pad)
+            # carry once per program.
+            points = lane_pack.pad_cols(points, d_pad)
+            cen = lane_pack.pad_rows(
+                lane_pack.pad_cols(centroids0, d_pad), k_pad)
             # Σ‖x‖² is iteration-invariant: hoist it so the hot loop reads the
             # point block exactly twice per iteration (the two MXU matmuls)
             pf = points.astype(jnp.float32)
             x_sq_sum = jnp.sum(pf * pf)
 
             def scan_body(c, _):
-                c_trim = c[: cfg.num_centroids]
-                new_c, cost = iter_body(c_trim, points, x_sq_sum)
-                newc_pad = jnp.pad(new_c, ((0, pad), (0, 0))) if pad else new_c
-                return newc_pad, cost
+                return iter_body(c, points, x_sq_sum)
 
             cen, costs = jax.lax.scan(scan_body, cen, None, length=cfg.iterations)
-            return cen[: cfg.num_centroids], costs
+            return cen[: cfg.num_centroids, : cfg.dim], costs
 
         return sess.spmd(fit_fn, in_specs=(sess.shard(), sess.replicate()),
                          out_specs=(sess.replicate(), sess.replicate()))
 
-    def _rotation_iter(self, points, centroids, k_pad, w, x_sq_sum, cdtype):
+    def _rotation_iter(self, points, cen_pad, k_pad, w, x_sq_sum, cdtype):
         """ml/java kmeans/rotation: centroid blocks circulate the ring; each worker
         scores its points against the resident block, tracking the block-local best;
         after a full cycle the global argmin resolves and stats are aggregated.
 
         Uses the SAME score formulation (‖c‖² − 2x·c) as every other variant so
         argmin tie-breaking is formulation-identical — the module's cross-variant
-        bit-identity claim depends on it. Padding rows (global id >=
-        num_centroids) are zero-filled and masked with +inf AFTER the score
-        matrix is computed."""
+        bit-identity claim depends on it. ``cen_pad`` arrives already padded
+        to (k_pad, d_pad) (lane_pack padding is part of the carry); phantom
+        rows (global id >= num_centroids) are zero-filled and masked with
+        +inf AFTER the score matrix is computed."""
         cfg = self.config
         block = k_pad // w
-        pad = k_pad - cfg.num_centroids
-        cen_pad = jnp.pad(centroids, ((0, pad), (0, 0))) if pad else centroids
         my = jax.lax.dynamic_slice_in_dim(
             cen_pad, lax_ops.worker_id() * block, block, axis=0)
 
@@ -180,8 +213,9 @@ class KMeans:
         counts = jnp.sum(onehot.astype(jnp.float32), axis=0)
         stats = jnp.concatenate([sums, counts[:, None]], axis=1)
         full = table_ops.allreduce(Table.local(stats, num_workers=w))
-        new_c = full.data[: cfg.num_centroids, :-1] / jnp.maximum(
-            full.data[: cfg.num_centroids, -1:], 1.0)
+        # keep the full padded table in the carry (phantom rows average to
+        # zero); fit_fn trims once at exit
+        new_c = full.data[:, :-1] / jnp.maximum(full.data[:, -1:], 1.0)
         # best_d holds scores; true sq-distance cost adds the Σ‖x‖² constant
         return new_c, jnp.sum(best_d) + x_sq_sum
 
@@ -203,7 +237,12 @@ class KMeans:
         With ``compute_dtype="bfloat16"`` the point block is STORED in bf16 —
         the E-step is HBM-bound on reading the points (twice per iteration), so
         halving the bytes is the dominant lever on v5e; norms and all
-        accumulations stay f32."""
+        accumulations stay f32.
+
+        With ``lane_pad`` (default) the stored block is feature-padded to a
+        128 multiple ONCE here, so every iteration's GEMM operands are
+        lane-aligned with no per-read re-tiling (zero columns are exact
+        no-ops in scores and sums)."""
         n = points.shape[0]
         if n % self.session.num_workers:
             raise ValueError(
@@ -211,6 +250,10 @@ class KMeans:
                 " (pad at ingest)")
         dtype = (jnp.bfloat16 if self.config.compute_dtype == "bfloat16"
                  else jnp.float32)
+        points = np.asarray(points)
+        if self.config.lane_pad and points.shape[1] < self._d_pad:
+            points = np.pad(points,
+                            ((0, 0), (0, self._d_pad - points.shape[1])))
         pts = self.session.scatter(jnp.asarray(points, dtype))
         cen = self.session.replicate_put(jnp.asarray(centroids0, jnp.float32))
         return pts, cen

@@ -22,6 +22,12 @@ TPU-native reformulation (SURVEY §7 "hard parts" — async semantics under SPMD
   between updates); convergence is statistical, not token-sequential.
 * Topic totals n_k are refreshed by psum once per hop — bounded staleness,
   replacing Harp's asynchronously drifting totals.
+* The count WRITE rides the one-hot-GEMM scatter engine (ops/lane_pack —
+  the shared software answer to TPU's missing per-lane HBM scatter), and
+  ``vocab_sub_block=128`` additionally buckets tokens per 128-wide vocab
+  SUB-block so the scatter GEMM is 128 lanes wide regardless of vocab size
+  (FLOPs ∝ 128·K per token instead of vpb·K — the r5 large-vocab crossover
+  remover; costs per-(doc, sub-block) padding, see bucketize_tokens_subblock).
 * The reference splits the word-topic table into numModelSlices=2 pipelined
   slices (LDAMPCollectiveMapper wTableMap[k]) so rotation overlaps sampling.
   Both schedules exist here: ``num_model_slices=1`` (single-slice
@@ -57,6 +63,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from harp_tpu.collectives import lax_ops, rotation
+from harp_tpu.ops import lane_pack
 from harp_tpu.parallel.mesh import WORKERS, fetch
 from harp_tpu.session import HarpSession
 
@@ -86,6 +93,17 @@ class LDAConfig:
     #     unit. CGS only (CVB0's soft deltas are not bf16-exact).
     #   * "gemm": BOTH sides as full-width f32 one-hot matmuls (legacy).
     #   "auto" picks gemm_scatter for cgs, gather otherwise.
+    #   The one-hot-GEMM implementation itself lives in ops/lane_pack.py
+    #   (the shared scatter engine; bitwise-equal to the r5 in-module copy).
+    vocab_sub_block: int = 0    # 0 = off; else (r6) the vocab-SUB-block token
+    #   layout: tokens are bucketized per (vocab block, sub-block of this
+    #   width), so the scatter's one-hot GEMM is `vocab_sub_block` lanes wide
+    #   (one batched GEMM over all sub-blocks) instead of vpb wide — FLOPs
+    #   ∝ 128 instead of V/(W·slices), which is what pushes large-vocab
+    #   configs (vpb·K ≈ 512k, the measured r5 crossover) back toward the
+    #   540M tokens/s no-scatter floor. Cost: per-(doc, sub-block) token
+    #   padding (tracked in last_layout_stats). 128 = the MXU lane width.
+    #   Requires method='cgs' and wt_access auto/gemm_scatter.
     num_model_slices: int = 1   # 1 = plain rotate_scan; 2 = the reference's
     #   numModelSlices=2 double-buffered schedule (half-width vocab blocks on
     #   pipelined_rotation: sample one half-slice while the other rotates)
@@ -103,39 +121,6 @@ class LDAConfig:
     #   point; refreshing counts between doc-groups restores near-sequential
     #   mixing (the analog of the reference's per-thread token batches under
     #   the dymoro timer, Scheduler.java:110-121)
-
-
-def _gemm_scatter(flat_ids, flat_delta, vpb: int, chunk: int):
-    """Count update Σ_t onehot(id_t) ⊗ delta_t as chunked bf16 one-hot GEMMs
-    with f32 accumulation (r5): XLA's scatter serializes at ~8.5 ns per
-    128-byte row (82% of the LDA hop); the MXU does the same reduction at
-    tens of TF/s. EXACT for CGS: one-hots are 0/1 and deltas ±1/0 — both
-    bf16-representable — and the accumulator is f32. The one-hot transient
-    is (chunk, vpb) bf16, never the full token count."""
-    n = flat_ids.shape[0]
-    pad = (-n) % chunk
-    if pad:                 # zero-delta pad rows contribute nothing; id 0
-        flat_ids = jnp.concatenate(  # is in-range so the one-hot is valid
-            [flat_ids, jnp.zeros((pad,), flat_ids.dtype)])
-        flat_delta = jnp.concatenate(
-            [flat_delta, jnp.zeros((pad,) + flat_delta.shape[1:],
-                                   flat_delta.dtype)])
-    nch = (n + pad) // chunk
-    k = flat_delta.shape[-1]
-    d_b = flat_delta.astype(jnp.bfloat16)
-
-    def step(acc, xs):
-        ids_c, d_c = xs
-        oh_c = (ids_c[:, None] == jnp.arange(vpb)[None, :]
-                ).astype(jnp.bfloat16)
-        return acc + jax.lax.dot_general(
-            oh_c, d_c, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32), None
-
-    upd, _ = jax.lax.scan(step, jnp.zeros((vpb, k), jnp.float32),
-                          (flat_ids.reshape(nch, chunk),
-                           d_b.reshape(nch, chunk, k)))
-    return upd
 
 
 def bucketize_tokens(docs: np.ndarray, num_blocks: int, vpb: int,
@@ -174,6 +159,31 @@ def bucketize_tokens(docs: np.ndarray, num_blocks: int, vpb: int,
     return docs_b, mask_b, lb
 
 
+def bucketize_tokens_subblock(docs: np.ndarray, num_blocks: int, vpb: int,
+                              sub: int, word_block: np.ndarray,
+                              word_slot: np.ndarray
+                              ) -> Tuple[np.ndarray, np.ndarray, int, int]:
+    """Vocab-SUB-block layout: bucket tokens per (vocab block, ``sub``-wide
+    sub-block of block-local slots), padded to the max per-(doc, sub-block)
+    count Lbs. Returns ``(docs_b (D, NB, NS*Lbs), mask_b, lb, lbs)`` with
+    ``lb = NS*Lbs`` and NS = vpb // sub; stored ids stay FULL block-local
+    slots (gather and sampling are layout-agnostic), but within a (doc,
+    block) row the tokens are grouped by sub-block, so the scatter can
+    reshape its deltas to (NS, ·, K) and run one batched ``sub``-lane-wide
+    one-hot GEMM (ops/lane_pack.gemm_scatter) instead of a vpb-wide one."""
+    if vpb % sub:
+        raise ValueError(f"vpb {vpb} must be a multiple of sub {sub}")
+    ns = vpb // sub
+    sub_of, _ = lane_pack.sub_block_split(word_slot, sub)
+    fine_block = (word_block * ns + sub_of).astype(word_block.dtype)
+    docs_f, mask_f, lbs = bucketize_tokens(
+        docs, num_blocks * ns, vpb, fine_block, word_slot)
+    d = docs.shape[0]
+    docs_b = docs_f.reshape(d, num_blocks, ns * lbs)
+    mask_b = mask_f.reshape(d, num_blocks, ns * lbs)
+    return docs_b, mask_b, ns * lbs, lbs
+
+
 class LDA:
     """Distributed CGS-LDA over a HarpSession mesh."""
 
@@ -197,6 +207,17 @@ class LDA:
             raise ValueError(
                 "wt_access='gemm_scatter' requires method='cgs' (CVB0's "
                 "soft deltas are not bf16-exact)")
+        if config.vocab_sub_block:
+            if config.vocab_sub_block < 1:
+                raise ValueError(
+                    f"vocab_sub_block must be positive, got "
+                    f"{config.vocab_sub_block}")
+            if config.method != "cgs" or config.wt_access not in (
+                    "auto", "gemm_scatter"):
+                raise ValueError(
+                    "vocab_sub_block requires method='cgs' with "
+                    "wt_access='auto'/'gemm_scatter' (the sub-block layout "
+                    "exists to narrow the gemm_scatter one-hot)")
         self.session = session
         self.config = config
         self._fns = {}
@@ -208,7 +229,8 @@ class LDA:
         return max(g for g in range(1, min(self.config.minibatches_per_hop,
                                            d_local) + 1) if d_local % g == 0)
 
-    def _build(self, w: int, v_pad: int, lb: int, d_local: int):
+    def _build(self, w: int, v_pad: int, lb: int, d_local: int,
+               lbs: int = 0):
         cfg = self.config
         k = cfg.num_topics
         ns = cfg.num_model_slices
@@ -228,21 +250,30 @@ class LDA:
                         and vpb <= 8192
                         and onehot_bytes <= 256 * 1024 * 1024))
         # gemm_scatter: bf16 one-hot GEMM count writes (exact for CGS's
-        # ±1/0 deltas) instead of the segment_sum that is 82% of the hop.
-        # Chunked so the transient one-hot stays ≤ ~64 MB (_gemm_scatter
-        # pads the token list to a chunk multiple; zero-delta pad rows
-        # contribute nothing).
+        # ±1/0 deltas — lane_pack's 'exact_pm1' policy) instead of the
+        # segment_sum that is 82% of the hop. Chunked by the engine so the
+        # transient one-hot stays ≤ ~64 MB (zero-delta pad rows contribute
+        # nothing).
         use_gemm_scatter = (cfg.wt_access == "gemm_scatter"
                             or (cfg.wt_access == "auto"
                                 and cfg.method == "cgs"))
-        budget_chunk = max(1, min(dg * lb,
-                                  (64 * 1024 * 1024) // max(2 * vpb, 1)))
-        # prefer an exact divisor near the budget (no pad concat per group);
-        # fall back to the budget size with zero-delta padding when the
-        # divisors are all small (e.g. dg*lb with a large prime factor)
-        div = next((c for c in range(budget_chunk, 0, -1)
-                    if (dg * lb) % c == 0), 1)
-        scatter_chunk = div if div >= budget_chunk // 2 else budget_chunk
+        # vocab-sub-block layout: the scatter runs as ONE batched GEMM over
+        # (NS, dg·Lbs, K) deltas against `sub`-lane-wide one-hots — FLOPs
+        # ∝ sub (=128), not vpb. Tokens arrive grouped by sub-block
+        # (bucketize_tokens_subblock), ids stay full block-local slots.
+        sub_w = cfg.vocab_sub_block
+        use_sub = bool(sub_w) and use_gemm_scatter
+        if use_sub:
+            if not lbs or lb % lbs or vpb % sub_w:
+                raise ValueError(
+                    f"sub-block build needs lb {lb} = NS*lbs ({lbs}) and "
+                    f"sub {sub_w} | vpb {vpb} (prepare() sets these)")
+            ns_sub = vpb // sub_w
+            scatter_chunk = lane_pack.scatter_chunk(dg * lbs, sub_w,
+                                                    batch=ns_sub)
+        else:
+            ns_sub = 1
+            scatter_chunk = lane_pack.scatter_chunk(dg * lb, vpb)
 
         def fit_fn(docs_b, mask_b, z0, wt_block0, seed):
             # docs_b/mask_b/z0: (D_local, NB, Lb) — tokens pre-bucketed by home
@@ -263,6 +294,37 @@ class LDA:
                 no_gather = "gather" in cfg.ablate_stage
                 no_scatter = "scatter" in cfg.ablate_stage
                 oh = None
+
+                def apply_scatter(wt_b, delta):
+                    """The ONE count-write path (shared by the full run and
+                    the sample ablation, whose stage budget by subtraction
+                    needs the unablated stages identical)."""
+                    if use_gemm:
+                        return wt_b + jax.lax.dot_general(
+                            oh, delta.reshape(-1, k),
+                            (((0,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+                    if use_sub:
+                        # tokens are grouped (dg, NS, Lbs); key the one-hot
+                        # on the within-sub slot and scatter all sub-blocks
+                        # in one batched `sub`-lane GEMM
+                        _, sub_slot = lane_pack.sub_block_split(
+                            wl_g.reshape(dg, ns_sub, lbs), sub_w)
+                        ids_s = sub_slot.transpose(1, 0, 2).reshape(
+                            ns_sub, dg * lbs)
+                        d_s = delta.reshape(dg, ns_sub, lbs, k).transpose(
+                            1, 0, 2, 3).reshape(ns_sub, dg * lbs, k)
+                        upd = lane_pack.gemm_scatter(
+                            ids_s, d_s, sub_w, chunk=scatter_chunk,
+                            policy="exact_pm1")
+                        return wt_b + upd.reshape(vpb, k)
+                    if use_gemm_scatter:
+                        return wt_b + lane_pack.gemm_scatter(
+                            wl_g.reshape(-1), delta.reshape(-1, k), vpb,
+                            chunk=scatter_chunk, policy="exact_pm1")
+                    return wt_b + jax.ops.segment_sum(
+                        delta.reshape(-1, k), wl_g.reshape(-1),
+                        num_segments=vpb)
                 if use_gemm and not (no_gather and no_scatter):
                     # the scatter GEMM needs the one-hot even when the
                     # gather is ablated (building it is part of either
@@ -285,22 +347,7 @@ class LDA:
                            * ms_g[..., None])
                     delta = new - cur
                     if not no_scatter:
-                        # the SAME write path as the full run — a stage
-                        # budget computed by subtraction needs the
-                        # unablated stages identical
-                        if use_gemm:
-                            wt_block = wt_block + jax.lax.dot_general(
-                                oh, delta.reshape(-1, k),
-                                (((0,), (0,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-                        elif use_gemm_scatter:
-                            wt_block = wt_block + _gemm_scatter(
-                                wl_g.reshape(-1), delta.reshape(-1, k),
-                                vpb, scatter_chunk)
-                        else:
-                            wt_block = wt_block + jax.ops.segment_sum(
-                                delta.reshape(-1, k), wl_g.reshape(-1),
-                                num_segments=vpb)
+                        wt_block = apply_scatter(wt_block, delta)
                     d_k = delta.sum(axis=(0, 1))
                     return (wt_block, tt_local + d_k, d_k, key,
                             zs_cheap, dt_g + delta.sum(axis=1))
@@ -332,20 +379,8 @@ class LDA:
                     new = (jax.nn.one_hot(zs_new, k, dtype=jnp.float32)
                            * ms_g[..., None])
                 delta = new - cur                             # (dg, Lb, K)
-                if no_scatter:
-                    pass                         # ablation: skip the wt write
-                elif use_gemm:
-                    wt_block = wt_block + jax.lax.dot_general(
-                        oh, delta.reshape(-1, k), (((0,), (0,)), ((), ())),
-                        preferred_element_type=jnp.float32)
-                elif use_gemm_scatter:
-                    wt_block = wt_block + _gemm_scatter(
-                        wl_g.reshape(-1), delta.reshape(-1, k), vpb,
-                        scatter_chunk)
-                else:
-                    wt_block = wt_block + jax.ops.segment_sum(
-                        delta.reshape(-1, k), wl_g.reshape(-1),
-                        num_segments=vpb)
+                if not no_scatter:               # ablation: skip the wt write
+                    wt_block = apply_scatter(wt_block, delta)
                 d_k = delta.sum(axis=(0, 1))
                 return (wt_block, tt_local + d_k, d_k, key,
                         zs_new, dt_g + delta.sum(axis=1))
@@ -466,6 +501,10 @@ class LDA:
         w = sess.num_workers
         nb = w * cfg.num_model_slices
         vpb = -(-cfg.vocab // nb)
+        if cfg.vocab_sub_block:
+            # sub-block layout: the block width must split into whole
+            # sub-blocks (extra slots are never-touched zero-count rows)
+            vpb = lane_pack.round_up(vpb, cfg.vocab_sub_block)
         v_pad = vpb * nb
         num_docs = docs.shape[0]
         if num_docs % w:
@@ -483,8 +522,13 @@ class LDA:
         else:
             word_block, word_slot = identity_assign(cfg.vocab, nb)
 
-        docs_b, mask_b, lb = bucketize_tokens(docs, nb, vpb, word_block,
-                                              word_slot)
+        if cfg.vocab_sub_block:
+            docs_b, mask_b, lb, lbs = bucketize_tokens_subblock(
+                docs, nb, vpb, cfg.vocab_sub_block, word_block, word_slot)
+        else:
+            docs_b, mask_b, lb = bucketize_tokens(docs, nb, vpb, word_block,
+                                                  word_slot)
+            lbs = 0
         d_local = num_docs // w
         nmb_eff = self._effective_minibatches(d_local)
         if nmb_eff == 1 and cfg.minibatches_per_hop > 1:
@@ -509,6 +553,11 @@ class LDA:
             # fits the configured budget (prime d_local can degrade this to 1,
             # which weakens mixing — check this field if convergence stalls)
             "minibatches_per_hop": nmb_eff,
+            # sub-block layout accounting (0/absent-width when off): the
+            # bench reports this padding next to the throughput it buys
+            "sub_block": cfg.vocab_sub_block,
+            "sub_blocks_per_block": (vpb // cfg.vocab_sub_block
+                                     if cfg.vocab_sub_block else 0),
         }
         rng = np.random.default_rng(seed)
         z0 = rng.integers(0, cfg.num_topics, docs_b.shape).astype(np.int32)
@@ -530,9 +579,9 @@ class LDA:
             z0 = (np.eye(cfg.num_topics, dtype=np.float32)[z0]
                   * mask_b[..., None])
 
-        key = (w, v_pad, lb, num_docs, cfg.method, cfg.num_model_slices)
+        key = (w, v_pad, lb, num_docs, cfg.method, cfg.num_model_slices, lbs)
         if key not in self._fns:
-            self._fns[key] = self._build(w, v_pad, lb, num_docs // w)
+            self._fns[key] = self._build(w, v_pad, lb, num_docs // w, lbs)
         return (key,
                 (sess.scatter(jnp.asarray(docs_b, jnp.int32)),
                  sess.scatter(jnp.asarray(mask_b, jnp.float32)),
@@ -609,6 +658,7 @@ class LDA:
             z_cur = sess.scatter(jnp.asarray(saved["z"]))
             wt_cur = sess.scatter(jnp.asarray(saved["wt"]))
         w, v_pad, lb, num_docs = key[:4]
+        lbs = key[6] if len(key) > 6 else 0
         chunk_fns = {}
         lls = []
         doc_topic = None
@@ -621,7 +671,8 @@ class LDA:
             chunk = min(save_every - ep % save_every, total - ep)
             if chunk not in chunk_fns:
                 sub = LDA(sess, dataclasses.replace(cfg, epochs=chunk))
-                chunk_fns[chunk] = sub._build(w, v_pad, lb, num_docs // w)
+                chunk_fns[chunk] = sub._build(w, v_pad, lb, num_docs // w,
+                                              lbs)
             doc_topic, wt_cur, z_cur, ll = chunk_fns[chunk](
                 docs_b, mask_b, z_cur, wt_cur,
                 jnp.asarray(int(seed) + ep, jnp.int32))

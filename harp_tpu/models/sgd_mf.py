@@ -69,7 +69,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from harp_tpu.collectives import lax_ops, rotation
-from harp_tpu.ops import pallas_kernels
+from harp_tpu.ops import lane_pack, pallas_kernels
 from harp_tpu.parallel.mesh import fetch
 from harp_tpu.session import HarpSession
 
@@ -391,8 +391,13 @@ class SGDMF:
                 cnt = cnt + jnp.sum(ccnt)
                 return w_new.reshape(rpw, -1), h_block, sse, cnt
 
-            col_tile = next((ct for ct in (512, 256, 128) if cpb % ct == 0),
-                            0)
+            # dense-stripe tiling rides the shared lane engine's constant:
+            # a fused-hop column tile must be a whole number of 128-lane
+            # MXU tiles AND divide the column block
+            col_tile = next((ct for ct in (4 * lane_pack.LANES,
+                                           2 * lane_pack.LANES,
+                                           lane_pack.LANES)
+                             if cpb % ct == 0), 0)
             fused = col_tile and pallas_kernels.use_dense_mf_pallas(
                 cpb, s_rows, self.config.rank)
 

@@ -19,10 +19,12 @@ implementation.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from harp_tpu.ops import lane_pack
 
 
 def pairwise_sq_dist(x: jax.Array, c: jax.Array,
@@ -71,7 +73,8 @@ def assign_clusters(x: jax.Array, c: jax.Array) -> jax.Array:
 
 
 def partial_sums_counts(
-    x: jax.Array, c: jax.Array, compute_dtype=None, x_sq_sum=None
+    x: jax.Array, c: jax.Array, compute_dtype=None, x_sq_sum=None,
+    valid_k: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One K-means E-step on this worker's block.
 
@@ -85,11 +88,18 @@ def partial_sums_counts(
 
     ``x_sq_sum``: precomputed Σ‖x‖² (scalar). Pass it when calling in a loop —
     it is iteration-invariant and hoisting it removes a full read of x.
+
+    ``valid_k``: when the centroid table carries phantom lane-padding rows
+    (ops/lane_pack: K padded to an MXU-lane multiple), rows >= valid_k are
+    masked out of the argmin (+inf score columns) so no point can assign to
+    padding; their sums/counts come out exactly zero.
     """
     # argmin over ‖x−c‖² == argmin over (‖c‖² − 2x·c): the per-row ‖x‖² term is
     # constant and never needs materializing — the E-step reads x exactly
     # twice (two MXU matmuls) and touches no (N, D)-sized temporaries.
     scores = pairwise_scores(x, c, compute_dtype)         # (N, K)
+    if valid_k is not None:
+        scores = lane_pack.mask_phantom_cols(scores, valid_k)
     xm = x if compute_dtype is None else x.astype(compute_dtype)
     assign = jnp.argmin(scores, axis=1)
     min_s = jnp.min(scores, axis=1)

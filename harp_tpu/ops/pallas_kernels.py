@@ -20,13 +20,14 @@ the autofuser genuinely can't produce rather than a default win.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from harp_tpu import compat
 from harp_tpu.ops import distance as xla_path
+from harp_tpu.ops import lane_pack
 
 try:
     from jax.experimental import pallas as pl
@@ -37,7 +38,7 @@ except Exception:      # pragma: no cover
 
 
 def _kmeans_tile_kernel(x_ref, c_ref, sums_ref, counts_ref, cost_ref,
-                        *, block_n: int, k: int):
+                        *, block_n: int, k: int, valid_k: int):
     """One N-tile: distances in VMEM, stats accumulated across grid steps.
 
     Mosaic constraints honed on real hardware: (1) the argmin/one-hot lowering
@@ -60,6 +61,13 @@ def _kmeans_tile_kernel(x_ref, c_ref, sums_ref, counts_ref, cost_ref,
     s = c2 - 2.0 * jax.lax.dot_general(
         x, c_mm, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)        # (block_n, K) in VMEM
+    if valid_k < k:
+        # phantom centroid rows (lane padding / the kernel's own 8-mult
+        # pad): mask their score columns with a huge FINITE value — +inf
+        # would turn the one-hot min extraction's 0·inf into NaN — so no
+        # point ever assigns to padding regardless of data scale
+        col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(col < valid_k, s, jnp.float32(1.7e38))
     assign = jnp.argmin(s, axis=1)
     onehot = jax.nn.one_hot(assign, k, dtype=x.dtype)
     min_sum = jnp.sum(onehot * s)
@@ -84,13 +92,17 @@ def _kmeans_tile_kernel(x_ref, c_ref, sums_ref, counts_ref, cost_ref,
 
 def kmeans_stats_pallas(
     x: jax.Array, c: jax.Array, block_n: int = 256,
-    interpret: bool = False,
+    interpret: bool = False, valid_k: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Fused E-step: returns (sums (K, D), counts (K,), cost scalar).
 
     Equivalent to ops/distance.partial_sums_counts but never writes the (N, K)
     distance matrix to HBM. ``x`` rows must be divisible by ``block_n`` (pad
     with rows equal to centroid 0 and subtract, or pick block_n | N).
+
+    ``valid_k``: centroid rows >= valid_k are phantom lane padding
+    (ops/lane_pack) — masked out of the argmin in-kernel, exactly like the
+    rows this function's own 8-multiple padding adds.
     """
     n, d = x.shape
     k = c.shape[0]
@@ -103,22 +115,25 @@ def kmeans_stats_pallas(
             f"block_n={block_n} exceeds 256: the mosaic argmin lowering "
             "allocates a (block_n, K, 128)-lane scoped temporary and blows the "
             "16 MB scoped-vmem budget (opaque compiler crash) — use <= 256")
+    valid = k if valid_k is None else min(valid_k, k)
     # mosaic blocks need (8, 128)-aligned trailing dims: pad features with
-    # zeros (distances/sums unchanged) and centroid ROWS with a huge constant
-    # so no point ever assigns to a padding centroid
-    d_pad = -(-d // 128) * 128
-    k_pad = -(-k // 8) * 8
+    # zeros (distances/sums unchanged) and centroid ROWS with zeros — the
+    # kernel masks every score column >= valid, so padding rows can never
+    # win the argmin at ANY data scale (r6: this replaces the old 1e6-fill,
+    # which a large-magnitude dataset could have out-scored)
+    d_pad = lane_pack.round_up(d, 128)
+    k_pad = lane_pack.round_up(k, 8)
     k_orig, d_orig = k, d
     c = c.astype(jnp.float32)       # centroids stay f32 (norm precision)
     if d_pad != d:
         x = jnp.pad(x, ((0, 0), (0, d_pad - d)))
         c = jnp.pad(c, ((0, 0), (0, d_pad - d)))
     if k_pad != k:
-        c = jnp.concatenate(
-            [c, jnp.full((k_pad - k, d_pad), 1e6, c.dtype)], axis=0)
+        c = lane_pack.pad_rows(c, k_pad)
     k, d = k_pad, d_pad
     g = n // block_n
-    kernel = functools.partial(_kmeans_tile_kernel, block_n=block_n, k=k)
+    kernel = functools.partial(_kmeans_tile_kernel, block_n=block_n, k=k,
+                               valid_k=valid)
     sums, counts2d, cost1 = pl.pallas_call(
         kernel,
         grid=(g,),
@@ -551,7 +566,8 @@ def use_dense_mf_pallas(cpb: int, s_rows: int, k: int) -> bool:
 
 
 def kmeans_stats(x: jax.Array, c: jax.Array, block_n: int = 256,
-                 compute_dtype=None, x_sq_sum=None
+                 compute_dtype=None, x_sq_sum=None,
+                 valid_k: Optional[int] = None
                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Dispatch: pallas when opted in (HARP_USE_PALLAS=1) on TPU, else XLA.
 
@@ -571,5 +587,6 @@ def kmeans_stats(x: jax.Array, c: jax.Array, block_n: int = 256,
     opted = os.environ.get("HARP_USE_PALLAS", "") == "1"
     if (_HAVE_PALLAS and on_tpu and opted and x.shape[0] % block_n == 0
             and x.dtype in (jnp.float32, jnp.bfloat16)):
-        return kmeans_stats_pallas(x, c, block_n)
-    return xla_path.partial_sums_counts(x, c, compute_dtype, x_sq_sum)
+        return kmeans_stats_pallas(x, c, block_n, valid_k=valid_k)
+    return xla_path.partial_sums_counts(x, c, compute_dtype, x_sq_sum,
+                                        valid_k=valid_k)

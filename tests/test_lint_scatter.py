@@ -1,0 +1,56 @@
+"""Hot-path scatter lint (tools/lint_scatter.py) — tier-1.
+
+XLA's indexed-update lowering serializes on the TPU scatter unit (measured
+8.8× slower than the one-hot-GEMM form, PERF.md r4/r5); hot code must route
+through ops/lane_pack. This test keeps the device trees clean and the
+allowlist honest.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import lint_scatter  # noqa: E402
+
+
+def test_hot_trees_have_no_unallowlisted_scatters():
+    violations = lint_scatter.check(REPO)
+    assert not violations, "\n".join(str(v) for v in violations)
+
+
+def test_allowlist_entries_are_all_live():
+    """An allowlist row whose code no longer scatters must be pruned —
+    otherwise it silently exempts FUTURE scatters in that function."""
+    assert lint_scatter.stale_allowlist_entries(REPO) == []
+
+
+def test_detects_a_new_hot_scatter():
+    src = (
+        "def hot_loop(x, idx, v):\n"
+        "    return x.at[idx].add(v)\n"
+    )
+    got = lint_scatter._scan_source(src, "harp_tpu/models/fake.py")
+    assert len(got) == 1
+    assert got[0].func == "hot_loop" and got[0].method == "add"
+    # .at[].set counts too; plain getitem (a gather) does not
+    src2 = ("def f(x, idx):\n"
+            "    y = x.at[idx].set(0.0)\n"
+            "    return y[idx]\n")
+    got2 = lint_scatter._scan_source(src2, "harp_tpu/ops/fake2.py")
+    assert [v.method for v in got2] == ["set"]
+
+
+def test_allowlisted_function_is_exempt_but_siblings_are_not():
+    src = ("def densify(x, idx, v):\n"
+           "    return x.at[idx].add(v)\n"
+           "def other(x, idx, v):\n"
+           "    return x.at[idx].add(v)\n")
+    got = lint_scatter._scan_source(src, "harp_tpu/models/sgd_mf.py")
+    assert [v.func for v in got] == ["other"]
+
+
+def test_cli_main_is_clean_on_this_repo(capsys):
+    assert lint_scatter.main([REPO]) == 0
+    assert "clean" in capsys.readouterr().out
