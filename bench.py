@@ -529,10 +529,16 @@ def cpu_nn_samples_per_sec(n, d, epochs, layers=(256, 128), batch_size=512):
     return n * epochs / (time.perf_counter() - t0)
 
 
-def tpu_attention(l=16384, h=8, dh=64, reps=100):
+def tpu_attention(l=16384, h=8, dh=64, reps=100, head_pack=None,
+                  causal=True):
     """Long-context blocked attention (pallas flash at L >= 8192) at the
     per-chip length SP exists for. Causal, one chip; the multi-chip ring adds
-    the ppermute hops on top."""
+    the ppermute hops on top.
+
+    ``head_pack``: None = the dispatcher's auto gate (packed at Dh<=64);
+    False pins the unpacked layout via HARP_FLASH_HEADPACK=0 so the r7
+    block-sparse-grid leg can be priced separately from the lane-packing
+    leg (the env var is restored after the measurement)."""
     import jax
     import jax.numpy as jnp
 
@@ -543,7 +549,7 @@ def tpu_attention(l=16384, h=8, dh=64, reps=100):
     def build(nr):
         def run(q0):
             def body(c, _):
-                o = ra.blocked_attention(c, c, c, causal=True)
+                o = ra.blocked_attention(c, c, c, causal=causal)
                 return c + 1e-20 * o, ()    # carry dependence: no hoisting
 
             out, _ = jax.lax.scan(body, q0, None, length=nr)
@@ -558,7 +564,19 @@ def tpu_attention(l=16384, h=8, dh=64, reps=100):
             np.asarray(fn(q)[0, 0])
         return timer
 
-    tp = two_point(build, max(reps // 4, 2), reps, float(l))
+    prev = os.environ.get("HARP_FLASH_HEADPACK")
+    try:
+        if head_pack is False:
+            os.environ["HARP_FLASH_HEADPACK"] = "0"
+        tp = two_point(build, max(reps // 4, 2), reps, float(l))
+    finally:
+        if head_pack is False:
+            if prev is None:
+                os.environ.pop("HARP_FLASH_HEADPACK", None)
+            else:
+                os.environ["HARP_FLASH_HEADPACK"] = prev
+    tp["config"] = (f"causal={causal} L={l} H={h} Dh={dh} "
+                    f"head_pack={'auto' if head_pack is None else head_pack}")
     return tp
 
 
@@ -857,8 +875,9 @@ def mesh_scaling_and_collectives(timeout=1800):
 # already-measured group's result when both are selected.
 ROW_GROUPS = ("kmeans", "kmeans_padded128", "kmeans_csr", "sgd_mf", "als",
               "pca", "lda", "lda_large", "lda_clueweb_subblock", "nn",
-              "nn_compute_bound", "attention", "kernel_svm", "mds", "sort",
-              "csr_cov", "kmeans_from_files", "p2p", "mesh")
+              "nn_compute_bound", "attention", "attention_blocksparse",
+              "kernel_svm", "mds", "sort", "csr_cov", "kmeans_from_files",
+              "p2p", "mesh")
 
 
 def main():
@@ -1082,6 +1101,7 @@ def main():
             "nn_compute_bound_mfu_pct": (
                 None if nn_big is None else nn_big["mfu_pct"])})
 
+    attn = None
     if want("attention"):
         begin("attention")
         attn_l = 2048 if small else 16384
@@ -1091,6 +1111,41 @@ def main():
             "attention_config": (
                 f"blocked causal L={attn_l} H=8 Dh=64 (1 chip)")})
         compact["attention_tokens_per_sec"] = round(attn["rate"])
+
+    if want("attention_blocksparse"):
+        # r7 rows, three legs of the flash rebuild at the r5 bench shape
+        # (L=16k causal; VERDICT r5 #1 target >= 2M tokens/s at Dh=64):
+        #  * blocksparse — trapezoid grid alone (head packing pinned OFF):
+        #    comparable head-to-head with the r5 1.10M row, isolates the
+        #    dead-block DMA removal;
+        #  * headpacked — trapezoid + two-heads-per-128-lane packing: the
+        #    Dh=64 DEFAULT dispatch, i.e. the SAME config the attention
+        #    group times — reused when both groups run (one number, not two
+        #    drifting copies of it), measured fresh only under --only;
+        #  * dh128 — Dh=128 heads (no packing applies: lanes already full),
+        #    quantifying what the Dh=64 padding cost either way.
+        # --small pins L=2048, BELOW the use_flash_pallas L>=8192 crossover:
+        # every leg would time the XLA scan and the legs' deltas would be
+        # scheduler noise wearing kernel labels — emit null instead.
+        begin("attention_blocksparse")
+        if small:
+            bs = hp = d128 = None
+        else:
+            bs = tpu_attention(l=16384, reps=200, head_pack=False)
+            hp = attn if attn is not None else tpu_attention(l=16384,
+                                                             reps=200)
+            d128 = tpu_attention(l=16384, h=4, dh=128, reps=200)
+        detail.update({
+            "attention_causal_blocksparse": bs,
+            "attention_headpacked": hp,
+            "attention_dh128": d128})
+        compact.update({
+            "attention_causal_blocksparse_tokens_per_sec": (
+                None if bs is None else round(bs["rate"])),
+            "attention_headpacked_tokens_per_sec": (
+                None if hp is None else round(hp["rate"])),
+            "attention_dh128_tokens_per_sec": (
+                None if d128 is None else round(d128["rate"]))})
 
     if want("kernel_svm"):
         # r4-component rows (VERDICT r4 weak #5: implemented but

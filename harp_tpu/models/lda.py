@@ -92,9 +92,22 @@ class LDAConfig:
     #     the scatter rides the MXU at tens of TF/s instead of the scatter
     #     unit. CGS only (CVB0's soft deltas are not bf16-exact).
     #   * "gemm": BOTH sides as full-width f32 one-hot matmuls (legacy).
-    #   "auto" picks gemm_scatter for cgs, gather otherwise.
+    #   "auto" picks gemm_scatter for cgs — UNLESS the vocab block is wider
+    #   than wt_gemm_scatter_max_vpb (below) and the sub-block layout is
+    #   off, in which case it falls back to gather (ADVICE r5: the one-hot
+    #   GEMM write costs vpb·K FLOPs per token, so a vpb~1M block would
+    #   regress far below the segment_sum path; the r6 auto had no guard).
     #   The one-hot-GEMM implementation itself lives in ops/lane_pack.py
     #   (the shared scatter engine; bitwise-equal to the r5 in-module copy).
+    wt_gemm_scatter_max_vpb: int = 65536   # auto-mode crossover guard: the
+    #   widest vocab block auto still routes to gemm_scatter. The measured
+    #   r5 crossover config (V=8000, K=64 → vpb=8064, vpb·K ≈ 516k FLOPs/
+    #   token) still wins ~1.9x over segment_sum; the FLOP cost scales
+    #   linearly in vpb while the scatter-unit cost does not, so 8x past
+    #   the measured-winning width is where auto stops gambling. Explicit
+    #   wt_access="gemm_scatter" is never overridden, and the vocab_sub_block
+    #   layout ignores the guard (its one-hot is 128 lanes wide regardless
+    #   of vpb — that layout exists precisely for the wide-vocab regime).
     vocab_sub_block: int = 0    # 0 = off; else (r6) the vocab-SUB-block token
     #   layout: tokens are bucketized per (vocab block, sub-block of this
     #   width), so the scatter's one-hot GEMM is `vocab_sub_block` lanes wide
@@ -253,10 +266,15 @@ class LDA:
         # ±1/0 deltas — lane_pack's 'exact_pm1' policy) instead of the
         # segment_sum that is 82% of the hop. Chunked by the engine so the
         # transient one-hot stays ≤ ~64 MB (zero-delta pad rows contribute
-        # nothing).
+        # nothing). Auto guards on the block width (ADVICE r5): past
+        # wt_gemm_scatter_max_vpb the vpb·K one-hot FLOPs lose to the
+        # segment_sum — fall back to gather — except under the sub-block
+        # layout, whose one-hot width is vocab_sub_block, not vpb.
         use_gemm_scatter = (cfg.wt_access == "gemm_scatter"
                             or (cfg.wt_access == "auto"
-                                and cfg.method == "cgs"))
+                                and cfg.method == "cgs"
+                                and (bool(cfg.vocab_sub_block)
+                                     or vpb <= cfg.wt_gemm_scatter_max_vpb)))
         # vocab-sub-block layout: the scatter runs as ONE batched GEMM over
         # (NS, dg·Lbs, K) deltas against `sub`-lane-wide one-hots — FLOPs
         # ∝ sub (=128), not vpb. Tokens arrive grouped by sub-block
@@ -274,6 +292,13 @@ class LDA:
         else:
             ns_sub = 1
             scatter_chunk = lane_pack.scatter_chunk(dg * lb, vpb)
+        # record the resolved write path (the auto guard makes it
+        # shape-dependent, so tests/benches read it instead of re-deriving)
+        self.last_layout_stats["wt_path"] = (
+            "gemm" if use_gemm
+            else "gemm_scatter_subblock" if use_sub
+            else "gemm_scatter" if use_gemm_scatter
+            else "gather")
 
         def fit_fn(docs_b, mask_b, z0, wt_block0, seed):
             # docs_b/mask_b/z0: (D_local, NB, Lb) — tokens pre-bucketed by home

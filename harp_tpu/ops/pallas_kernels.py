@@ -292,21 +292,71 @@ def dense_mf_hop_pallas(vb: jax.Array, w_t: jax.Array, h_t: jax.Array,
 # FULL query block and round-trips the (H, L) running stats + (H, L, Dv)
 # accumulator through HBM on every KV step — measured 4.6 TFLOP/s effective
 # at L=16k. This kernel holds one query tile's stats/accumulator in VMEM
-# scratch across the KV-innermost grid, so HBM traffic collapses to one pass
-# over Q/K/V plus the output write. Grid (H, Lq/bq, Lkv/bk), KV innermost —
-# sequential on TPU, which is exactly what the running softmax needs.
+# scratch across a KV-innermost grid, so HBM traffic collapses to one pass
+# over Q/K/V plus the output write.
 #
-# Causal blocks entirely above the diagonal are predicated OFF with
-# pl.when (r5; exact — they contributed p = 0): the static mosaic grid
-# still visits them and their block DMAs land, but the dots/exp are
-# skipped (938k → 1.10M tokens/s at L=16k causal). Partially-masked
-# diagonal blocks mask to -inf as usual.
+# r7 — the grid is BLOCK-SPARSE BY CONSTRUCTION for causal. The r5 kernel
+# predicated fully-masked causal blocks off with pl.when (exact — they
+# contributed p = 0; 938k → 1.10M tokens/s at L=16k), but the static mosaic
+# grid still VISITED them and their block DMAs still landed — half the KV
+# traffic of a causal pass moved dead bytes. Now the (q-tile, kv-block)
+# pairs are flattened host-side into a trapezoid (_flash_grid_layout): q
+# tile iq visits exactly n_kv_live(iq) = ceil(((iq+1)·bq)/bk) KV blocks, and
+# the scalar-prefetched index maps (PrefetchScalarGridSpec) steer each grid
+# step's DMA from the flat step id — blocks above the diagonal are never
+# visited and never fetched. At bq=256/bk=512/L=16k that is 1056 KV-block
+# fetches instead of 2048 (the exact L(L+2·bq)/2 trapezoid).
+#
+# r7 — HEAD PACKING fills the 128 MXU lanes at Dh ≤ 64. Unpacked, a Dh=64
+# head pads its contraction to 128 lanes and half the dot-product lanes
+# compute zeros. Packed, head pairs share one 128-lane tile ([q_even|q_odd]
+# on lanes 0-63/64-127) and K/V expand IN-KERNEL to a block-diagonal
+# (2·bk, 128) tile ([k_even|0] over [0|k_odd]), so one (bq,128)×(128,2bk)
+# dot computes BOTH heads' scores with every contraction lane live, and the
+# two heads' score columns stay separable (cols [0,bk) vs [bk,2bk)). The
+# running max/denominator ride the same (bq,128) scratch with one head per
+# lane half. Q/K/V/O also ship at 64 real lanes per head instead of a
+# zero-padded 128 — HBM traffic halves on top of the MXU fill.
+
+_PACK_LANES = 64       # lane split point: head-even on [0,64), head-odd on
+#   [64,128). Packing engages only for dh, dv <= 64 and even H.
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, d_ref, acc_ref,
-                  *, bq: int, bk: int, n_kv: int, causal: bool, scale: float,
-                  l_real: int):
-    j = pl.program_id(2)
+def _flash_grid_layout(n_q: int, n_kv: int, bq: int, bk: int, causal: bool):
+    """Flat (q-tile, kv-block) visit order for the flash grid.
+
+    Returns int32 arrays ``(iq_of, j_of)`` of length T — the flat grid's
+    step → (q tile, kv block) map, consumed by the kernel's scalar-prefetch
+    index maps. Causal: a trapezoid — q tile iq visits only the
+    ``min(n_kv, ceil(((iq+1)·bq)/bk))`` KV blocks at or below the diagonal,
+    so fully-masked blocks are never part of the grid (no visit, no DMA).
+    Non-causal: the full rectangle in KV-innermost order. The accounting
+    tests assert directly on these arrays — they ARE the index map.
+    """
+    import numpy as np
+
+    iq_of, j_of = [], []
+    for iq in range(n_q):
+        m = n_kv if not causal else min(n_kv, -(-((iq + 1) * bq) // bk))
+        iq_of.extend([iq] * m)
+        j_of.extend(range(m))
+    return (np.asarray(iq_of, np.int32), np.asarray(j_of, np.int32))
+
+
+def _flash_kernel(iq_ref, j_ref, q_ref, k_ref, v_ref, *refs,
+                  bq: int, bk: int, n_kv: int, causal: bool, scale: float,
+                  l_real: int, packed: bool, return_stats: bool):
+    """One flat-grid step: fold KV block j_of[t] into q tile iq_of[t].
+
+    Scratch m/d are (bq, 128): unpacked they are row-replicated; packed,
+    lanes [0,64) carry the even head and [64,128) the odd head."""
+    if return_stats:
+        o_ref, m_out_ref, d_out_ref, m_ref, d_ref, acc_ref = refs
+    else:
+        o_ref, m_ref, d_ref, acc_ref = refs
+    t = pl.program_id(1)
+    iq = iq_ref[t]
+    j = j_ref[t]
 
     @pl.when(j == 0)
     def _init():
@@ -314,70 +364,131 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, d_ref, acc_ref,
         d_ref[...] = jnp.zeros_like(d_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    # causal: blocks ENTIRELY above the diagonal contribute p = 0 to every
-    # accumulator — skip their MXU work outright (the grid still visits
-    # them and their DMAs land, but the dots/exp are predicated off; ~1.9×
-    # of a causal pass was masked compute, r5). A block is fully masked iff
-    # its smallest key position exceeds its largest query position.
-    iq = pl.program_id(1)
-    live = (j * bk <= (iq + 1) * bq - 1) if causal else (j >= 0)
-
-    @pl.when(live)
-    def _block():
-        q = q_ref[0]                               # (bq, D)
-        k = k_ref[0]                               # (bk, D)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        ragged = n_kv * bk != l_real     # L padded up: mask padded KEY rows
-        if causal or ragged:
-            q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk),
-                                                       0)
-            k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk),
-                                                      1)
-            mask = (q_pos >= k_pos) if causal else (q_pos >= 0)
-            if ragged:
-                mask = jnp.logical_and(mask, k_pos < l_real)
-            s = jnp.where(mask, s, -1e30)
-        m_prev = m_ref[...]                        # (bq, 128) row-replicated
-        m_cur = jnp.max(s, axis=1)[:, None]        # (bq, 1)
-        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
-        alpha = jnp.exp(m_prev - m_new)            # (bq, 128)
+    q = q_ref[0]                                   # (bq, DL)
+    kb = k_ref[0]                                  # (bk, DL)
+    vb = v_ref[0]
+    if packed:
+        # expand the [k_even|k_odd] lane-packed block to the block-diagonal
+        # (2bk, 128) form: rows [0,bk) keep even-head lanes, rows [bk,2bk)
+        # keep odd-head lanes. The zeros never touch HBM — built in VMEM.
+        lo = jax.lax.broadcasted_iota(jnp.int32, kb.shape, 1) < _PACK_LANES
+        kb = jnp.concatenate([jnp.where(lo, kb, jnp.zeros_like(kb)),
+                              jnp.where(lo, jnp.zeros_like(kb), kb)], axis=0)
+        lov = jax.lax.broadcasted_iota(jnp.int32, vb.shape, 1) < _PACK_LANES
+        vb = jnp.concatenate([jnp.where(lov, vb, jnp.zeros_like(vb)),
+                              jnp.where(lov, jnp.zeros_like(vb), vb)], axis=0)
+    s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    # s: (bq, bk) unpacked; (bq, 2bk) packed with head-even cols [0, bk)
+    ragged = n_kv * bk != l_real     # L padded up: mask padded KEY rows
+    if causal or ragged:
+        q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        c_idx = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        k_pos = j * bk + (c_idx % bk if packed else c_idx)
+        mask = (q_pos >= k_pos) if causal else (q_pos >= 0)
+        if ragged:
+            mask = jnp.logical_and(mask, k_pos < l_real)
+        s = jnp.where(mask, s, -1e30)
+    m_prev = m_ref[...]                            # (bq, 128)
+    if packed:
+        lane = jax.lax.broadcasted_iota(jnp.int32, m_prev.shape, 1)
+        m0 = jnp.max(s[:, :bk], axis=1)[:, None]   # (bq, 1) head-even
+        m1 = jnp.max(s[:, bk:], axis=1)[:, None]   # (bq, 1) head-odd
+        m_cur = jnp.where(lane < _PACK_LANES,
+                          jnp.broadcast_to(m0, m_prev.shape),
+                          jnp.broadcast_to(m1, m_prev.shape))
+    else:
+        m_cur = jnp.broadcast_to(jnp.max(s, axis=1)[:, None], m_prev.shape)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)                # (bq, 128)
+    if packed:
+        m_cols = jnp.concatenate(
+            [jnp.broadcast_to(m_new[:, :1], (bq, bk)),
+             jnp.broadcast_to(m_new[:, _PACK_LANES:_PACK_LANES + 1],
+                              (bq, bk))], axis=1)
+        p = jnp.exp(s - m_cols)                    # (bq, 2bk)
+        d0 = jnp.sum(p[:, :bk], axis=1)[:, None]
+        d1 = jnp.sum(p[:, bk:], axis=1)[:, None]
+        d_blk = jnp.where(lane < _PACK_LANES,
+                          jnp.broadcast_to(d0, m_prev.shape),
+                          jnp.broadcast_to(d1, m_prev.shape))
+        acc_scale = alpha          # per-lane: each half scales its own head
+    else:
         p = jnp.exp(s - m_new[:, :1])              # (bq, bk)
-        d_ref[...] = d_ref[...] * alpha + jnp.broadcast_to(
-            jnp.sum(p, axis=1)[:, None], m_prev.shape)
-        # v cast to f32: p is f32 (exp of scores) and mosaic dots need
-        # matching operand dtypes — bf16 would otherwise fail lowering
-        acc_ref[...] = acc_ref[...] * jnp.broadcast_to(
-            alpha[:, :1], acc_ref.shape) + \
-            jax.lax.dot_general(p, v_ref[0].astype(jnp.float32),
-                                (((1,), (0,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        m_ref[...] = m_new
+        d_blk = jnp.broadcast_to(jnp.sum(p, axis=1)[:, None], m_prev.shape)
+        acc_scale = jnp.broadcast_to(alpha[:, :1], acc_ref.shape)
+    d_ref[...] = d_ref[...] * alpha + d_blk
+    # v cast to f32: p is f32 (exp of scores) and mosaic dots need matching
+    # operand dtypes — bf16 would otherwise fail lowering. Packed: p's col
+    # halves hit v's block-diagonal rows, so head outputs land in disjoint
+    # lane halves of acc.
+    acc_ref[...] = acc_ref[...] * acc_scale + jax.lax.dot_general(
+        p, vb.astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_ref[...] = m_new
 
-    @pl.when(j == n_kv - 1)
+    # the last LIVE block for this q tile (not n_kv-1: the trapezoid ends at
+    # the diagonal) — recomputed from iq, mirroring _flash_grid_layout
+    j_last = (jnp.minimum(n_kv, ((iq + 1) * bq + bk - 1) // bk) - 1
+              if causal else n_kv - 1)
+
+    @pl.when(j == j_last)
     def _finish():
-        den = jnp.broadcast_to(d_ref[...][:, :1], acc_ref.shape)
-        o_ref[0] = acc_ref[...] / jnp.maximum(den, 1e-30)
+        den = jnp.maximum(d_ref[...], 1e-30)
+        if packed:
+            o_ref[0] = acc_ref[...] / den
+        else:
+            o_ref[0] = acc_ref[...] / jnp.broadcast_to(den[:, :1],
+                                                       acc_ref.shape)
+        if return_stats:
+            m_out_ref[0] = m_ref[...]
+            d_out_ref[0] = d_ref[...]
 
 
 def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
                            causal: bool = False, bq: int = 256, bk: int = 512,
-                           interpret: bool = False) -> jax.Array:
+                           interpret: bool = False,
+                           head_pack: Optional[bool] = None,
+                           return_stats: bool = False):
     """Single-chip flash attention: q/k (L, H, Dh), v (L, H, Dv) →
     (L, H, Dv).
 
     ANY L is accepted — the sequence pads up to a block multiple inside the
-    wrapper and padded KEY rows are masked to −inf in the kernel (padded
-    QUERY rows are sliced off the output), so the 2.5× win covers ragged
-    lengths too (VERDICT r4 #10). Dh and Dv pad to lane multiples
-    independently (Dv ≠ Dh is fine — cross-attention/Ulysses value heads).
-    Dispatched by ``parallel.ring_attention.blocked_attention`` on TPU
-    (opt-out HARP_FLASH_PALLAS=0).
+    wrapper and padded KEY rows are masked inside the kernel (padded QUERY
+    rows are sliced off the output), so the win covers ragged lengths too
+    (VERDICT r4 #10). Dh and Dv pad to lane multiples independently
+    (Dv ≠ Dh is fine — cross-attention/Ulysses value heads). Dispatched by
+    ``parallel.ring_attention.blocked_attention`` on TPU (opt-out
+    HARP_FLASH_PALLAS=0).
+
+    ``causal=True`` runs the block-sparse trapezoid grid (r7): above-diagonal
+    KV blocks are not in the grid at all — never visited, never DMA'd.
+
+    ``head_pack``: None = auto (:func:`use_flash_head_pack`); True forces the
+    two-heads-per-128-lane packed layout (raises if shapes don't allow it);
+    False forces the unpacked layout.
+
+    ``return_stats``: also return the streaming-softmax stats
+    ``(out, m (L, H), den (L, H))`` so a caller can MERGE this result with
+    other KV blocks' partial attention (the ring-attention hop composition:
+    num = out·den). Stats rows for padded queries are sliced off with the
+    output.
     """
     from jax.experimental.pallas import tpu as pltpu
 
     l, h, dh = q.shape
     dv = v.shape[-1]
+    pack_ok = h % 2 == 0 and dh <= _PACK_LANES and dv <= _PACK_LANES
+    if head_pack is None:
+        packed = pack_ok and use_flash_head_pack(h, dh, dv)
+    elif head_pack:
+        if not pack_ok:
+            raise ValueError(
+                f"head_pack=True needs even H and Dh/Dv <= {_PACK_LANES}, "
+                f"got H={h} Dh={dh} Dv={dv}")
+        packed = True
+    else:
+        packed = False
     bq = min(bq, l)
     bk = min(bk, l)
     # q and kv axes pad INDEPENDENTLY to their own block multiples (a shared
@@ -385,36 +496,82 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
     # other — L=257 would have padded 256x)
     l_pad_q = -(-l // bq) * bq
     l_pad_kv = -(-l // bk) * bk
-    d_pad = -(-dh // 128) * 128
-    dv_pad = -(-dv // 128) * 128
-    qt = jnp.transpose(q, (1, 0, 2))               # (H, L, D)
-    kt = jnp.transpose(k, (1, 0, 2))
-    vt = jnp.transpose(v, (1, 0, 2))
-    qt = jnp.pad(qt, ((0, 0), (0, l_pad_q - l), (0, d_pad - dh)))
-    kt = jnp.pad(kt, ((0, 0), (0, l_pad_kv - l), (0, d_pad - dh)))
-    vt = jnp.pad(vt, ((0, 0), (0, l_pad_kv - l), (0, dv_pad - dv)))
     scale = 1.0 / float(dh) ** 0.5
+    n_q = l_pad_q // bq
     n_kv = l_pad_kv // bk
+    iq_of, j_of = _flash_grid_layout(n_q, n_kv, bq, bk, causal)
+    if packed:
+        h_dim = h // 2
+        d_q = d_k = d_v = 2 * _PACK_LANES
+
+        def pack_heads(x, d_real, l_pad):
+            # (L, H, d) → (HP, L_pad, 128): head 2i on lanes [0,64),
+            # head 2i+1 on [64,128) — no zero-padded 128-lane per-head tile
+            # ever reaches HBM
+            x = jnp.pad(x, ((0, l_pad - l), (0, 0),
+                            (0, _PACK_LANES - d_real)))
+            return jnp.transpose(
+                x.reshape(l_pad, h_dim, 2 * _PACK_LANES), (1, 0, 2))
+
+        qt = pack_heads(q, dh, l_pad_q)
+        kt = pack_heads(k, dh, l_pad_kv)
+        vt = pack_heads(v, dv, l_pad_kv)
+    else:
+        h_dim = h
+        d_q = d_k = -(-dh // 128) * 128
+        d_v = -(-dv // 128) * 128
+        qt = jnp.pad(jnp.transpose(q, (1, 0, 2)),
+                     ((0, 0), (0, l_pad_q - l), (0, d_q - dh)))
+        kt = jnp.pad(jnp.transpose(k, (1, 0, 2)),
+                     ((0, 0), (0, l_pad_kv - l), (0, d_k - dh)))
+        vt = jnp.pad(jnp.transpose(v, (1, 0, 2)),
+                     ((0, 0), (0, l_pad_kv - l), (0, d_v - dv)))
     kernel = functools.partial(_flash_kernel, bq=bq, bk=bk, n_kv=n_kv,
-                               causal=causal, scale=scale, l_real=l)
-    out = pl.pallas_call(
-        kernel,
-        grid=(h, l_pad_q // bq, n_kv),
+                               causal=causal, scale=scale, l_real=l,
+                               packed=packed, return_stats=return_stats)
+    out_shape = [jax.ShapeDtypeStruct((h_dim, l_pad_q, d_v), jnp.float32)]
+    out_specs = [pl.BlockSpec((1, bq, d_v),
+                              lambda hh, t, iqr, jr: (hh, iqr[t], 0))]
+    if return_stats:
+        for _ in range(2):                         # running max, denominator
+            out_shape.append(
+                jax.ShapeDtypeStruct((h_dim, l_pad_q, 128), jnp.float32))
+            out_specs.append(pl.BlockSpec(
+                (1, bq, 128), lambda hh, t, iqr, jr: (hh, iqr[t], 0)))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                     # iq_of, j_of
+        grid=(h_dim, len(iq_of)),
         in_specs=[
-            pl.BlockSpec((1, bq, d_pad), lambda hh, i, j: (hh, i, 0)),
-            pl.BlockSpec((1, bk, d_pad), lambda hh, i, j: (hh, j, 0)),
-            pl.BlockSpec((1, bk, dv_pad), lambda hh, i, j: (hh, j, 0)),
+            pl.BlockSpec((1, bq, d_q), lambda hh, t, iqr, jr: (hh, iqr[t], 0)),
+            pl.BlockSpec((1, bk, d_k), lambda hh, t, iqr, jr: (hh, jr[t], 0)),
+            pl.BlockSpec((1, bk, d_v), lambda hh, t, iqr, jr: (hh, jr[t], 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, dv_pad), lambda hh, i, j: (hh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((h, l_pad_q, dv_pad), jnp.float32),
+        out_specs=out_specs,
         scratch_shapes=[
-            pltpu.VMEM((bq, 128), jnp.float32),    # running max (row-repl)
+            pltpu.VMEM((bq, 128), jnp.float32),    # running max
             pltpu.VMEM((bq, 128), jnp.float32),    # running denominator
-            pltpu.VMEM((bq, dv_pad), jnp.float32),  # output accumulator
+            pltpu.VMEM((bq, d_v), jnp.float32),    # output accumulator
         ],
+    )
+    outs = pl.pallas_call(
+        kernel, grid_spec=grid_spec, out_shape=out_shape,
         interpret=interpret,
-    )(qt, kt, vt)
-    return jnp.transpose(out, (1, 0, 2))[:l, :, :dv]
+    )(jnp.asarray(iq_of), jnp.asarray(j_of), qt, kt, vt)
+    if packed:
+        o = jnp.transpose(outs[0], (1, 0, 2)).reshape(
+            l_pad_q, h, _PACK_LANES)[:l, :, :dv]
+    else:
+        o = jnp.transpose(outs[0], (1, 0, 2))[:l, :, :dv]
+    if not return_stats:
+        return o
+
+    def unpack_stat(raw):
+        if packed:
+            st = jnp.stack([raw[..., 0], raw[..., _PACK_LANES]], axis=-1)
+            return jnp.transpose(st, (1, 0, 2)).reshape(l_pad_q, h)[:l]
+        return jnp.transpose(raw[..., 0])[:l]
+
+    return o, unpack_stat(outs[1]), unpack_stat(outs[2])
 
 
 def use_flash_pallas(l: int) -> bool:
@@ -431,6 +588,21 @@ def use_flash_pallas(l: int) -> bool:
     if jax.default_backend() != "tpu":
         return False
     return l >= 8192
+
+
+def use_flash_head_pack(h: int, dh: int, dv: int) -> bool:
+    """Head-packing gate for the flash kernel: pack two heads per 128-lane
+    tile when BOTH head dims fit a 64-lane half and H is even — at Dh=64
+    the unpacked layout computes zeros on half the MXU contraction lanes
+    AND ships a zero-padded 128-lane tile per head through HBM; packing
+    fixes both. At Dh > 64 the lanes are already full (the bench's Dh=128
+    row quantifies the no-padding case). Opt out with
+    HARP_FLASH_HEADPACK=0."""
+    import os
+
+    if os.environ.get("HARP_FLASH_HEADPACK", "1") == "0":
+        return False
+    return h % 2 == 0 and 0 < dh <= _PACK_LANES and 0 < dv <= _PACK_LANES
 
 
 # --------------------------------------------------------------------------- #

@@ -21,7 +21,7 @@ of the runtime (same mesh, same collectives).
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -100,17 +100,98 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return num / jnp.maximum(den, 1e-30)[:, None]
 
 
+def _hop_stats(q, kb, vb, scale, diag_causal: bool, use_flash: bool,
+               interpret: bool = False):
+    """One ring hop's streaming-softmax pieces for ALL heads.
+
+    q (Lq, H, Dh) against this hop's resident KV block (Lk, H, Dh/Dv).
+    Returns ``(m (Lq, H), num (Lq, H, Dv), den (Lq, H))`` — exactly the
+    partial-attention pieces :func:`_softmax_merge` folds across hops.
+
+    ``diag_causal`` applies the in-block diagonal causal mask — hop 0 of a
+    causal ring, the only hop whose mask is partial. Every LATER hop's KV
+    block is either entirely before this worker's queries (fully live, no
+    mask) or entirely after (fully dead — dropped by the merge's validity
+    flag), so the hop itself never masks; that is how the ring's per-hop KV
+    blocks compose with the flash kernel's per-tile causal extents: the
+    block-sparse trapezoid runs once, on the diagonal hop.
+
+    ``use_flash``: run the hop through the pallas flash kernel
+    (``return_stats=True`` — VMEM-resident running stats, block-sparse
+    causal grid, head packing) instead of the XLA einsum path.
+    """
+    if use_flash:
+        from harp_tpu.ops import pallas_kernels as _pk
+
+        out, m, den = _pk.flash_attention_pallas(
+            q, kb, vb, causal=diag_causal, return_stats=True,
+            interpret=interpret)
+        return m, out * den[..., None], den
+    s = jnp.einsum("qhd,khd->hqk", q, kb,
+                   preferred_element_type=jnp.float32) * scale
+    if diag_causal:
+        lq, lk = q.shape[0], kb.shape[0]
+        mask = jnp.arange(lq)[:, None] >= jnp.arange(lk)[None, :]
+        # -1e30, not -inf: the diagonal guarantees every row keeps at least
+        # its own key, so m stays finite and exp(-1e30 - m) underflows to 0
+        s = jnp.where(mask[None], s, -1e30)
+    m = jnp.max(s, axis=2)                                 # (H, Lq)
+    p = jnp.exp(s - m[..., None])
+    num = jnp.einsum("hqk,khd->qhd", p, vb,
+                     preferred_element_type=jnp.float32)
+    return jnp.transpose(m), num, jnp.transpose(jnp.sum(p, axis=2))
+
+
 def ring_attention_mha(q: jax.Array, k: jax.Array, v: jax.Array,
-                       causal: bool = False, axis_name: str = WORKERS
-                       ) -> jax.Array:
-    """Multi-head ring attention: q/k/v (L/W, H, Dh), heads vmapped over the
-    single-head kernel (one ppermute ring per step carries all heads — the
-    vmap is inside the rotation, so collectives do not multiply). Drop-in
-    peer of :func:`ulysses_attention` for the sequence-sharded layout."""
-    per_head = jax.vmap(
-        lambda qh, kh, vh: ring_attention(qh, kh, vh, causal, axis_name),
-        in_axes=1, out_axes=1)
-    return per_head(q, k, v)
+                       causal: bool = False, axis_name: str = WORKERS,
+                       use_flash: Optional[bool] = None,
+                       interpret: bool = False) -> jax.Array:
+    """Multi-head ring attention: q/k/v (L/W, H, Dh) → (L/W, H, Dv).
+
+    One ppermute ring per hop carries all heads; each hop folds the
+    resident KV block into the running streaming softmax. r7: hops are
+    native multi-head and dispatch through the flash kernel on TPU
+    (``use_flash=None`` → :func:`~harp_tpu.ops.pallas_kernels.use_flash_pallas`
+    on the local block length): hop 0 — the only partially-masked hop of a
+    causal ring — runs the block-sparse causal trapezoid; hops t ≥ 1 run
+    unmasked full attention and are kept or dropped WHOLE by the merge's
+    validity flag (``wid >= t``), so no per-hop (Lq, Lk) mask is ever
+    built for them. Drop-in peer of :func:`ulysses_attention` for the
+    sequence-sharded layout."""
+    w = compat.axis_size(axis_name)
+    wid = lax_ops.worker_id(axis_name)
+    lq = q.shape[0]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    if use_flash is None:
+        from harp_tpu.ops import pallas_kernels as _pk
+
+        use_flash = _pk.use_flash_pallas(lq)
+    # hop 0: the resident block is this worker's own — the diagonal (and,
+    # for causal, the ONLY partially-masked block); every row keeps >= 1 key
+    m_run, num, den = _hop_stats(q, k, v, scale, causal, use_flash,
+                                 interpret)
+    if w > 1:
+        kv = jax.tree.map(lambda x: lax_ops.rotate(x, 1, axis_name), (k, v))
+
+        def body(carry, kv_block, tm1):
+            m_r, nu, de = carry
+            kb, vb = kv_block
+            m_b, num_b, den_b = _hop_stats(q, kb, vb, scale, False,
+                                           use_flash, interpret)
+            if causal:
+                # hop t holds worker (wid - t) mod w's block: fully live
+                # when it is before this worker's rows (wid >= t), fully
+                # dead when it wrapped around — no partial masks after hop 0
+                valid = jnp.broadcast_to(wid >= tm1 + 1, m_r.shape)
+            else:
+                valid = jnp.ones(m_r.shape, bool)
+            m_r, nu, de = _softmax_merge(m_r, nu, de, m_b, num_b, den_b,
+                                         valid)
+            return (m_r, nu, de), (kb, vb)
+
+        (m_run, num, den), _ = rotation.rotate_scan(
+            body, (m_run, num, den), kv, w - 1, axis_name)
+    return num / jnp.maximum(den, 1e-30)[..., None]
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,

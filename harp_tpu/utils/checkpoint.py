@@ -34,6 +34,11 @@ import numpy as np
 
 log = logging.getLogger("harp_tpu.checkpoint")
 
+# tmp dirs from OTHER writers younger than this survive _prune: they may
+# belong to a live concurrent writer on a shared work dir (elastic restart
+# overlap / cross-host pid collision); older ones are fail-stop orphans
+STALE_TMP_SECONDS = 3600.0
+
 # jax and orbax are imported LAZILY: the gang supervisor verifies checkpoints
 # (latest_valid_step(deep=False) → verify_step_dir) between relaunches, and
 # that path must stay numpy-only — the supervisor must never initialize a jax
@@ -391,12 +396,27 @@ class Checkpointer:
         # runs on the writer thread under async_save — must NOT call steps()
         # (its wait() would join the writer's own in-flight future: deadlock)
         import shutil
+        import time
 
         steps = self._list_steps()
         for s in steps[: max(0, len(steps) - self.keep)]:
             shutil.rmtree(self._step_dir(s), ignore_errors=True)
+        now = time.time()
         for name in os.listdir(self.directory):
-            # stale tmp dirs from a writer killed mid-write (fail-stop)
-            if ".tmp-" in name and not name.endswith(f"tmp-{os.getpid()}"):
-                shutil.rmtree(os.path.join(self.directory, name),
-                              ignore_errors=True)
+            # stale tmp dirs from a writer killed mid-write (fail-stop).
+            # ADVICE r5: a foreign-pid tmp dir is NOT proof of a dead
+            # writer — on a shared work dir it may belong to a concurrently
+            # LIVE writer (overlapping elastic restart, pid collision
+            # across hosts), whose in-flight save this rmtree would kill.
+            # Only reap dirs old enough that any live write would long have
+            # renamed them away (writes are seconds; the threshold is an
+            # hour).
+            if ".tmp-" not in name or name.endswith(f"tmp-{os.getpid()}"):
+                continue
+            path = os.path.join(self.directory, name)
+            try:
+                age = now - os.path.getmtime(path)
+            except OSError:
+                continue          # racing rename/delete: no longer a tmp
+            if age >= STALE_TMP_SECONDS:
+                shutil.rmtree(path, ignore_errors=True)

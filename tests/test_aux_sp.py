@@ -48,6 +48,26 @@ def test_checkpointer_async_save_roundtrip(tmp_path):
     assert asy.steps()[-1] == 4
 
 
+def test_prune_spares_live_foreign_tmp_dir(tmp_path):
+    """_prune must not delete a concurrently LIVE writer's tmp dir (ADVICE
+    r5): a fresh foreign-pid ``*.tmp-*`` dir survives every prune; only one
+    past the staleness threshold (a fail-stop orphan) is reaped."""
+    ck = checkpoint.Checkpointer(str(tmp_path), keep=1, use_orbax=False)
+    fresh = tmp_path / "step_000000000099.tmp-99999"   # foreign pid, live
+    fresh.mkdir()
+    (fresh / "payload.npz").write_bytes(b"in-flight")
+    stale = tmp_path / "step_000000000098.tmp-88888"   # fail-stop orphan
+    stale.mkdir()
+    old = time.time() - 2 * checkpoint.STALE_TMP_SECONDS
+    os.utime(stale, (old, old))
+    state = {"a": np.ones(2)}
+    ck.save(1, state)
+    ck.save(2, state)                                  # both prune
+    assert fresh.exists(), "live writer's tmp dir was deleted by prune"
+    assert not stale.exists(), "stale orphan tmp dir survived prune"
+    assert ck.steps() == [2]
+
+
 def test_checkpointer_numpy_fallback(tmp_path):
     ck = checkpoint.Checkpointer(str(tmp_path), use_orbax=False)
     state = {"a": np.ones(4), "b": np.zeros((2, 2))}
@@ -104,7 +124,16 @@ def test_bench_collectives_smoke(session):
     for r in results:
         assert r.seconds > 0 and r.us_per_op > 0
     table = bench.format_table(results)
-    assert "allreduce" in table and "GB/s" in table
+    assert "allreduce" in table and "busbw GB/s" in table
+    # renamed fields say what they mean (ADVICE r5): the PER-WORKER payload
+    # (total array bytes / W) and NCCL-busbw bandwidth, with the convention
+    # note available to ship inside emitted records
+    w2 = session.num_workers ** 2
+    rows = max(w2, (4 * 1024 // 4) // 128 // w2 * w2)
+    assert results[0].payload_bytes_per_worker == \
+        rows * 128 * 4 // session.num_workers
+    assert results[0].busbw_gbps > 0
+    assert "busbw" in bench.CONVENTION_NOTE
 
 
 def test_pallas_kmeans_kernel_interpret_matches_xla():
@@ -282,22 +311,132 @@ def test_flash_attention_interpret_matches_reference():
     """The pallas flash kernel (interpret mode) is exact vs the replicated
     reference, causal and not, across tilings including multi-block grids,
     RAGGED lengths (prime L — padded keys masked inside the kernel,
-    VERDICT r4 #10) and Dv != Dh value heads."""
+    VERDICT r4 #10) and Dv != Dh value heads. r7: every pack-eligible shape
+    (even H, Dh/Dv <= 64) also runs the two-heads-per-128-lane packed
+    layout, which must be bit-for-par with the unpacked one."""
     rng = np.random.default_rng(21)
     for l, h, dh, dv, causal in [(64, 2, 16, 16, False),
                                  (64, 2, 16, 16, True),
                                  (96, 1, 8, 8, True),
                                  (61, 2, 16, 16, False),   # prime L
                                  (97, 1, 8, 8, True),      # prime L, causal
-                                 (64, 2, 16, 24, True)]:   # Dv != Dh
+                                 (64, 2, 16, 24, True),    # Dv != Dh
+                                 (127, 4, 64, 64, True)]:  # prime L, Dh=64
         q = jnp.asarray(rng.standard_normal((l, h, dh)), jnp.float32)
         k = jnp.asarray(rng.standard_normal((l, h, dh)), jnp.float32)
         v = jnp.asarray(rng.standard_normal((l, h, dv)), jnp.float32)
         ref = jax.vmap(lambda a, b, c: ring_attention.reference_attention(
             a, b, c, causal), in_axes=1, out_axes=1)(q, k, v)
-        got = pallas_kernels.flash_attention_pallas(q, k, v, causal,
-                                                    bq=32, bk=32,
-                                                    interpret=True)
-        assert got.shape == (l, h, dv)
-        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
-                                   rtol=1e-4, atol=1e-5)
+        packs = [False]
+        if h % 2 == 0 and dh <= 64 and dv <= 64:
+            packs.append(True)
+        for hp in packs:
+            got = pallas_kernels.flash_attention_pallas(
+                q, k, v, causal, bq=32, bk=32, interpret=True, head_pack=hp)
+            assert got.shape == (l, h, dv)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       rtol=1e-4, atol=1e-5)
+
+
+def test_flash_attention_interpret_bf16():
+    """bf16 q/k/v through the kernel (both layouts) tracks the f32
+    reference within bf16 mantissa tolerance — the second dtype of the
+    existing kernel test matrix (the K-means kernel tests bf16 the same
+    way), at an aligned AND a prime (ragged-padding) length."""
+    rng = np.random.default_rng(23)
+    for l in (64, 61):
+        h, dh = 2, 32
+        q = jnp.asarray(rng.standard_normal((l, h, dh)), jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal((l, h, dh)), jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal((l, h, dh)), jnp.bfloat16)
+        ref = jax.vmap(lambda a, b, c: ring_attention.reference_attention(
+            a.astype(jnp.float32), b.astype(jnp.float32),
+            c.astype(jnp.float32), True), in_axes=1, out_axes=1)(q, k, v)
+        for hp in (False, True):
+            got = pallas_kernels.flash_attention_pallas(
+                q, k, v, True, bq=32, bk=32, interpret=True, head_pack=hp)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       rtol=3e-2, atol=3e-2)
+
+
+def test_flash_causal_grid_is_blocksparse():
+    """The causal grid NEVER fetches a fully-masked KV block: the
+    scalar-prefetch layout arrays ARE the kernel's index map, so asserting
+    on them is asserting what the DMA engine is steered to. The trapezoid
+    visits ~L(L+bk)/2 worth of KV positions, not L²."""
+    layout = pallas_kernels._flash_grid_layout
+    # bench shape: L=16384, bq=256, bk=512 — 64 q tiles x 32 kv blocks
+    n_q, n_kv, bq, bk = 64, 32, 256, 512
+    iq_of, j_of = layout(n_q, n_kv, bq, bk, causal=True)
+    # 1) no dead blocks: every visited pair has its smallest key position
+    #    <= its largest query position
+    assert np.all(j_of * bk <= (iq_of + 1) * bq - 1)
+    # 2) no live block is missed and none visits twice: per q tile exactly
+    #    ceil(((iq+1)*bq)/bk) blocks, each once
+    for iq in range(n_q):
+        js = np.sort(j_of[iq_of == iq])
+        m = min(n_kv, -(-((iq + 1) * bq) // bk))
+        assert js.tolist() == list(range(m))
+    # 3) the r5 grid visited n_q*n_kv = 2048 blocks; the trapezoid visits
+    #    1056 — the DMA traffic the pl.when predication could not remove
+    assert len(iq_of) == 1056 < 0.55 * n_q * n_kv
+    # 4) with bq == bk the visited KV positions are EXACTLY L(L+bk)/2
+    l = 4096
+    b = 256
+    iq_sq, j_sq = layout(l // b, l // b, b, b, causal=True)
+    assert len(iq_sq) * b * b == l * (l + b) // 2
+    # non-causal stays the full rectangle
+    iq_r, j_r = layout(4, 3, 32, 32, causal=False)
+    assert len(iq_r) == 12 and j_r.max() == 2
+
+
+def test_flash_stats_compose_ring_hops():
+    """return_stats exposes the streaming-softmax pieces so ring hops can
+    merge flash-kernel partial results: a diagonal-causal hop over the own
+    block merged with a full hop over an earlier block equals the causal
+    reference — the exact composition ring_attention_mha runs."""
+    rng = np.random.default_rng(29)
+    l, h, dh = 64, 4, 16
+    lq = l // 2
+    q = jnp.asarray(rng.standard_normal((l, h, dh)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((l, h, dh)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((l, h, dh)), jnp.float32)
+    ref = jax.vmap(lambda a, b, c: ring_attention.reference_attention(
+        a, b, c, True), in_axes=1, out_axes=1)(q, k, v)
+    q1 = q[lq:]                         # "worker 1"'s query rows
+    o0, m0, d0 = pallas_kernels.flash_attention_pallas(
+        q1, k[lq:], v[lq:], causal=True, bq=16, bk=16, interpret=True,
+        return_stats=True)              # hop 0: own (diagonal) block
+    o1, m1, d1 = pallas_kernels.flash_attention_pallas(
+        q1, k[:lq], v[:lq], causal=False, bq=16, bk=16, interpret=True,
+        return_stats=True)              # hop 1: fully-live earlier block
+    valid = jnp.ones(m0.shape, bool)
+    _, num, den = ring_attention._softmax_merge(
+        m0, o0 * d0[..., None], d0, m1, o1 * d1[..., None], d1, valid)
+    out = num / jnp.maximum(den, 1e-30)[..., None]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref[lq:]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ring_attention_mha_flash_hops_match_reference(session):
+    """The full ring schedule with flash-kernel hops (interpret mode inside
+    shard_map) matches the replicated reference — the TPU dispatch path,
+    exercised end to end on the 8-worker CPU mesh."""
+    rng = np.random.default_rng(31)
+    l, h, dh = 64, 4, 16
+    q = rng.standard_normal((l, h, dh)).astype(np.float32)
+    k = rng.standard_normal((l, h, dh)).astype(np.float32)
+    v = rng.standard_normal((l, h, dh)).astype(np.float32)
+    for causal in (True, False):
+        ref = np.stack([
+            np.asarray(ring_attention.reference_attention(
+                jnp.asarray(q[:, i]), jnp.asarray(k[:, i]),
+                jnp.asarray(v[:, i]), causal)) for i in range(h)], axis=1)
+        out = session.run(
+            lambda a, b, c: ring_attention.ring_attention_mha(
+                a, b, c, causal, use_flash=True, interpret=True),
+            session.scatter(jnp.asarray(q)), session.scatter(jnp.asarray(k)),
+            session.scatter(jnp.asarray(v)),
+            in_specs=(session.shard(),) * 3, out_specs=session.shard())
+        np.testing.assert_allclose(np.asarray(out), ref,
+                                   rtol=2e-3, atol=2e-3)

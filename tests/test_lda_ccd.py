@@ -65,6 +65,38 @@ def test_lda_gemm_scatter_bitwise_matches_segment_sum(session):
                                        wt_access="gemm_scatter"))
 
 
+def test_lda_auto_wt_access_vpb_crossover_guard(session):
+    """wt_access='auto' falls back to the gather path when the vocab block
+    is wider than wt_gemm_scatter_max_vpb (ADVICE r5: the one-hot GEMM
+    write costs vpb*K FLOPs per token — a vpb~1M config must not regress),
+    while the sub-block layout keeps gemm_scatter at ANY width (its one-hot
+    is 128 lanes regardless of vpb) and an explicit request is never
+    overridden."""
+    docs = datagen.lda_corpus(num_docs=32, vocab=96, num_topics=4,
+                              doc_len=12, seed=9)
+
+    def built_path(cfg):
+        model = lda.LDA(session, cfg)
+        model.fit(docs, seed=2)
+        return model.last_layout_stats["wt_path"]
+
+    # vocab=96 over 8 workers -> vpb=12: within any sane threshold
+    assert built_path(lda.LDAConfig(num_topics=4, vocab=96,
+                                    epochs=1)) == "gemm_scatter"
+    # force the crossover with a tiny threshold: auto must pick gather
+    assert built_path(lda.LDAConfig(
+        num_topics=4, vocab=96, epochs=1,
+        wt_gemm_scatter_max_vpb=8)) == "gather"
+    # sub-block layout ignores the guard (scatter width is 128, not vpb)
+    assert built_path(lda.LDAConfig(
+        num_topics=4, vocab=96, epochs=1, vocab_sub_block=4,
+        wt_gemm_scatter_max_vpb=8)) == "gemm_scatter_subblock"
+    # explicit gemm_scatter is never overridden by the guard
+    assert built_path(lda.LDAConfig(
+        num_topics=4, vocab=96, epochs=1, wt_access="gemm_scatter",
+        wt_gemm_scatter_max_vpb=8)) == "gemm_scatter"
+
+
 def test_lda_convergence_parity_with_sequential_cgs(session):
     """VERDICT #6: the 8-worker blocked CGS reaches the same likelihood as a
     single-device token-sequential CGS within tolerance at equal epochs.

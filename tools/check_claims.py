@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""Claims honesty check: README/PERF headline throughput numbers must match
+the latest committed bench record.
+
+VERDICT r5 #8: PERF.md claimed "every BASELINE workload clears the ... bound
+by >=6x" while the official record read ALS 5.22 and LDA 5.44 — numeric
+prose drifts the moment a number is retyped instead of checked. This tool
+pins every headline claim to the committed ``BENCH_local.json``: each entry
+below names the doc, a regex whose single capture group is the claimed
+number (K/M/G/B suffixes understood), where the recorded value lives in the
+bench record, and the relative band the claim must sit inside (default 10%
+— wider than any committed spread column, narrower than any real drift
+class; entries quoting run-to-run bands in prose still check their headline
+number).
+
+Failure modes are all loud:
+  * claimed number outside the band          → the prose drifted (or the
+    record moved and the prose was not updated with it);
+  * regex no longer matches the doc          → stale checker entry (the
+    claim was reworded without updating this table — same rule as
+    lint_scatter's stale-allowlist check);
+  * bench value missing or null              → the claim asserts a number
+    the committed record does not (yet) back — unmeasured rows must not be
+    quoted as measured.
+
+Usage: ``python tools/check_claims.py [repo_root]`` — exits nonzero on any
+violation. ``tests/test_check_claims.py`` runs it in tier-1.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+from typing import Callable, List, NamedTuple, Optional, Union
+
+_SUFFIX = {"K": 1e3, "M": 1e6, "G": 1e9, "B": 1e9}
+
+BENCH_FILE = "BENCH_local.json"
+
+
+class Claim(NamedTuple):
+    claim_id: str
+    doc: str                    # repo-relative doc path
+    pattern: str                # regex; group(1) = the claimed number
+    source: Union[tuple, Callable]   # key path into the bench record, or
+    #   a callable(bench) -> float for derived quantities (e.g. Xeon lbs)
+    rel_tol: float = 0.10
+
+
+def _xeon_lb(rate_key: str, anchor_key: str):
+    return lambda b: b[rate_key]["rate"] / b[anchor_key] / 36.0
+
+
+CLAIMS: List[Claim] = [
+    # README headline table ("Headline rows from the committed benchmark
+    # record") — one claim per row that states a number
+    Claim("kmeans_flagship", "README.md",
+          r"\| K-means regroupallgather \(flagship\) \|[^|]*\| (\S+) iters/s",
+          ("kmeans", "rate")),
+    Claim("sgd_mf", "README.md",
+          r"\| SGD-MF dense masked-stripe \|[^|]*\| (\S+) ratings/s",
+          ("sgd_mf", "rate")),
+    Claim("lda", "README.md",
+          r"\| CGS-LDA \(gemm_scatter count writes\) \|[^|]*\| (\S+) "
+          r"tokens/s",
+          ("lda", "rate")),
+    Claim("lda_clueweb", "README.md",
+          r"\| CGS-LDA clueweb-regime \|[^|]*\| (\S+) tokens/s",
+          ("lda_large", "rate")),
+    Claim("als", "README.md",
+          r"\| ALS implicit \(pallas lane Cholesky\) \|[^|]*\| (\S+) "
+          r"iters/s",
+          ("als", "rate")),
+    Claim("pca", "README.md",
+          r"\| PCA correlation \|[^|]*\| (\S+) fits/s",
+          ("pca", "rate")),
+    Claim("nn", "README.md",
+          r"\| Mini-batch NN \|[^|]*\| (\S+) samples/s",
+          ("nn", "rate")),
+    Claim("attention", "README.md",
+          r"\| Flash attention \(pallas\) \|[^|]*\| (\S+) tokens/s",
+          ("attention", "rate")),
+    Claim("kmeans_csr", "README.md",
+          r"\| K-means CSR densify / CSR covariance \|[^|]*\| (\S+) iters/s",
+          ("kmeans_csr", "rate")),
+    Claim("csr_cov", "README.md",
+          r"\| K-means CSR densify / CSR covariance \|[^|]*\|[^|]*iters/s "
+          r"/ (\S+) passes/s",
+          ("csr_covariance", "rate")),
+    Claim("native_parse", "README.md",
+          r"\| Native CSV parse \|[^|]*\| (\S+) MB/s",
+          ("kmeans_from_files", "load_native_mb_per_sec")),
+    # README architecture-table prose rates
+    Claim("sgd_mf_arch_row", "README.md",
+          r"fused pallas hop — (\S+) samples/s on one v5e chip",
+          ("sgd_mf", "rate")),
+    Claim("lda_arch_row", "README.md",
+          r"bitwise-exact, 2× the hop — (\S+) tokens/s on one chip",
+          ("lda", "rate")),
+    Claim("kmeans_csr_arch_row", "README.md",
+          r"scatter-free block-densify-GEMM default — (\S+) iters/s on chip",
+          ("kmeans_csr", "rate")),
+    # PERF.md: the smallest Xeon lower bound, stated per workload (the
+    # ">=6x" drift class this checker exists to kill)
+    Claim("min_xeon_lb_als", "PERF.md",
+          r"workloads: ALS (\S+)×",
+          _xeon_lb("als", "als_cpu_anchor_iters_per_sec")),
+    Claim("min_xeon_lb_lda", "PERF.md",
+          r"workloads: ALS \S+×, LDA (\S+)×",
+          _xeon_lb("lda", "lda_cpu_anchor_tokens_per_sec")),
+]
+
+
+def parse_value(text: str) -> Optional[float]:
+    """'1397' → 1397.0; '1.11M' → 1.11e6; '3.05B'/'3.05G' → 3.05e9."""
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)([KMGB])?", text)
+    if not m:
+        return None
+    return float(m.group(1)) * _SUFFIX.get(m.group(2) or "", 1.0)
+
+
+def _lookup(bench: dict, source) -> Optional[float]:
+    if callable(source):
+        try:
+            return float(source(bench))
+        except (KeyError, TypeError, ZeroDivisionError):
+            return None
+    node = bench
+    for key in source:
+        if not isinstance(node, dict) or key not in node:
+            return None
+        node = node[key]
+    return float(node) if isinstance(node, (int, float)) else None
+
+
+def check_claim(claim: Claim, doc_text: str, bench: dict) -> Optional[str]:
+    """One claim against one doc + bench record; None = consistent."""
+    m = re.search(claim.pattern, doc_text)
+    if not m:
+        return (f"{claim.doc}: claim '{claim.claim_id}' not found — the "
+                f"prose was reworded; update its entry in "
+                f"tools/check_claims.py (pattern {claim.pattern!r})")
+    claimed = parse_value(m.group(1))
+    if claimed is None:
+        return (f"{claim.doc}: claim '{claim.claim_id}' captured "
+                f"{m.group(1)!r}, not a number — fix the pattern")
+    recorded = _lookup(bench, claim.source)
+    if recorded is None:
+        return (f"{claim.doc}: claim '{claim.claim_id}' states "
+                f"{m.group(1)} but the bench record has no measured value "
+                f"for it (missing/null) — unmeasured rows must not be "
+                f"quoted as numbers")
+    if abs(claimed - recorded) > claim.rel_tol * abs(recorded):
+        return (f"{claim.doc}: claim '{claim.claim_id}' states "
+                f"{m.group(1)} but the committed record reads "
+                f"{recorded:.4g} (> {100 * claim.rel_tol:.0f}% off) — "
+                f"update the prose or re-measure")
+    return None
+
+
+def check(repo: str, claims: Optional[List[Claim]] = None) -> List[str]:
+    with open(os.path.join(repo, BENCH_FILE)) as f:
+        bench = json.load(f)
+    docs = {}
+    violations = []
+    for claim in claims if claims is not None else CLAIMS:
+        if claim.doc not in docs:
+            with open(os.path.join(repo, claim.doc)) as f:
+                docs[claim.doc] = f.read()
+        v = check_claim(claim, docs[claim.doc], bench)
+        if v:
+            violations.append(v)
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    repo = argv[0] if argv else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    violations = check(repo)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} claim(s) out of sync with {BENCH_FILE}")
+        return 1
+    print(f"all {len(CLAIMS)} headline claims within their "
+          f"{BENCH_FILE} bands")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
