@@ -741,7 +741,7 @@ def _lgamma(x):
     try:
         from scipy.special import gammaln
         return gammaln(x)
-    except Exception:
+    except ImportError:
         from math import lgamma
         return np.vectorize(lgamma)(x)
 

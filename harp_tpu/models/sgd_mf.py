@@ -601,11 +601,17 @@ class SGDMF:
             v = jnp.where(m > 0, v, jnp.asarray(jnp.nan, bf))
             return v.reshape((1, n_blocks, rpw, cpb))
 
-        v_slab = sess.spmd(
+        # one-shot prepare-time program, routed through session.run — the
+        # documented build-and-invoke-once entry point (jaxlint JL103). It
+        # still traces per prepare call (prepare runs once per layout);
+        # programs that must keep their trace cache hold the session.spmd
+        # callable instead.
+        v_slab = sess.run(
             densify,
+            sess.scatter(idx_p), sess.scatter(val_p), sess.scatter(msk_p),
             in_specs=(sess.shard(), sess.shard(), sess.shard()),
             out_specs=sess.shard(),
-        )(sess.scatter(idx_p), sess.scatter(val_p), sess.scatter(msk_p))
+        )
 
         # regularizer counts (host): per-(worker, block, row) and
         # per-(worker, block, stripe, col)

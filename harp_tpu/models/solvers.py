@@ -191,7 +191,10 @@ class Solver:
                 (name, gl[name]) for name in code.co_names if name in gl)
             key = (code, contents, ref_globals, defaults)
             hash(key)
-        except Exception:
+        except (TypeError, ValueError):
+            # unhashable closure/global contents (jax arrays, dicts, ...):
+            # fall back to identity keying — correct, just retraces per
+            # objective instance
             return objective
         return key
 

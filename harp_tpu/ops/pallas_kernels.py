@@ -32,7 +32,7 @@ from harp_tpu.ops import lane_pack
 try:
     from jax.experimental import pallas as pl
     _HAVE_PALLAS = True
-except Exception:      # pragma: no cover
+except ImportError:    # pragma: no cover
     pl = None
     _HAVE_PALLAS = False
 

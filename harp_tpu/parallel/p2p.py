@@ -82,7 +82,8 @@ def _kv_client():
         from jax._src import distributed as _jd
 
         return _jd.global_state.client
-    except Exception:
+    except (ImportError, AttributeError):
+        # jax._src layout shifts across versions; no gang = no global_state
         return None
 
 
@@ -100,7 +101,7 @@ def _routable_host() -> str:
         from jax._src import distributed as _jd
 
         coord = _jd.global_state.coordinator_address
-    except Exception:
+    except (ImportError, AttributeError):
         pass
     coord_host = coord.rsplit(":", 1)[0] if coord else None
     if coord_host:

@@ -55,7 +55,7 @@ def _orbax():
         try:
             import orbax.checkpoint as ocp
             _ocp_cached = ocp
-        except Exception:  # pragma: no cover - baked-in image has orbax
+        except ImportError:  # pragma: no cover - baked-in image has orbax
             _ocp_cached = None
     return _ocp_cached
 

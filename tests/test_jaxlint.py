@@ -1,0 +1,314 @@
+"""jaxlint (tools/jaxlint) — tier-1.
+
+Three layers, mirroring tests/test_check_claims.py's contract style:
+
+* fixture snippets with KNOWN violations assert the exact finding codes
+  each checker raises (and that the clean twin of each snippet is silent);
+* the repo itself must lint clean (this is the tier-1 wiring — a new
+  violation anywhere in harp_tpu/ fails the suite, so DOTS_PASSED captures
+  the lint exactly like the scatter lint it absorbed);
+* the allowlist contract: justifications are mandatory, stale entries fail;
+* the jaxpr engine: traced collective budgets must match the committed
+  tools/collective_budget.json, and drift is detected loudly.
+"""
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.jaxlint import checkers_jaxpr  # noqa: E402
+from tools.jaxlint import checkers_ast as ca  # noqa: E402
+from tools.jaxlint.allowlist import ALLOWLIST  # noqa: E402
+from tools.jaxlint.core import (Finding, apply_allowlist,  # noqa: E402
+                                run_ast_checkers, validate_allowlist)
+
+
+def _run(checker, src, rel="harp_tpu/models/fake.py"):
+    return checker(ast.parse(src), rel, src)
+
+
+def _codes(findings):
+    return [f.code for f in findings]
+
+
+# -- JL101 collective-divergence -------------------------------------------
+
+def test_collective_in_rank_branch_is_flagged():
+    src = (
+        "def step(x):\n"
+        "    wid = lax_ops.worker_id()\n"
+        "    if wid == 0:\n"
+        "        x = jax.lax.psum(x, 'workers')\n"
+        "    return x\n")
+    got = _run(ca.check_collective_divergence, src)
+    assert _codes(got) == ["JL101"]
+    assert got[0].func == "step" and "psum" in got[0].message
+
+
+def test_collective_divergence_nested_and_else_branch():
+    src = (
+        "def step(x):\n"
+        "    if jax.process_index() != 0:\n"
+        "        y = 1\n"
+        "    else:\n"
+        "        for _ in range(3):\n"
+        "            x = lax_ops.allgather(x)\n"
+        "    return x\n")
+    assert _codes(_run(ca.check_collective_divergence, src)) == ["JL101"]
+
+
+def test_masked_contribution_idiom_is_clean():
+    # the lax_ops.broadcast shape: EVERY worker calls the collective, the
+    # rank condition only masks the contribution — no divergence
+    src = (
+        "def bcast(x, root):\n"
+        "    mask = jax.lax.axis_index('workers') == root\n"
+        "    return jax.lax.psum(jnp.where(mask, x, 0.0), 'workers')\n")
+    assert _run(ca.check_collective_divergence, src) == []
+    # rank-conditional HOST work (no collective inside) is also fine
+    src2 = (
+        "def save(x):\n"
+        "    if jax.process_index() == 0:\n"
+        "        np.savetxt('out.csv', x)\n")
+    assert _run(ca.check_collective_divergence, src2) == []
+
+
+# -- JL102 axis-name --------------------------------------------------------
+
+def test_unknown_axis_literal_is_flagged():
+    src = (
+        "def step(x):\n"
+        "    return jax.lax.psum(x, axis_name='worker')\n")   # typo'd axis
+    got = _run(ca.check_axis_name, src)
+    assert _codes(got) == ["JL102"] and "'worker'" in got[0].message
+
+
+def test_declared_or_canonical_axes_are_clean():
+    src = (
+        "MY_AXIS = 'ring'\n"
+        "def step(x, mesh):\n"
+        "    a = jax.lax.psum(x, 'workers')\n"        # canonical
+        "    b = jax.lax.all_gather(x, 'ring')\n"     # declared above
+        "    c = lax_ops.allreduce(x, axis_name=WORKERS)\n"  # constant ref
+        "    return a, b, c\n")
+    assert _run(ca.check_axis_name, src) == []
+
+
+# -- JL103 retrace-hazard ---------------------------------------------------
+
+def test_immediately_invoked_jit_is_flagged():
+    src = (
+        "def fit(sess, x):\n"
+        "    return sess.spmd(lambda a: a + 1, in_specs=s, out_specs=s)(x)\n")
+    got = _run(ca.check_retrace_hazard, src)
+    assert _codes(got) == ["JL103"] and "one expression" in got[0].message
+
+
+def test_jit_in_loop_without_cache_guard_is_flagged():
+    src = (
+        "def fit(sess, xs):\n"
+        "    for x in xs:\n"
+        "        f = jax.jit(step)\n"
+        "        f(x)\n")
+    assert _codes(_run(ca.check_retrace_hazard, src)) == ["JL103"]
+    # the repo's cache idiom is clean: the wrapper is STORED in a container
+    guarded = (
+        "def fit(self, sess, xs):\n"
+        "    for x in xs:\n"
+        "        if x.shape not in self._fns:\n"
+        "            self._fns[x.shape] = jax.jit(step)\n"
+        "        self._fns[x.shape](x)\n")
+    assert _run(ca.check_retrace_hazard, guarded) == []
+    # an unrelated `not in` membership test is NOT a cache: a plain-name
+    # bind inside it still rebuilds the wrapper every iteration
+    skip_filter = (
+        "def fit(sess, xs):\n"
+        "    for x in xs:\n"
+        "        if x.tag not in SKIP:\n"
+        "            f = jax.jit(step)\n"
+        "            f(x)\n")
+    assert _codes(_run(ca.check_retrace_hazard, skip_filter)) == ["JL103"]
+
+
+def test_jitted_mutable_default_and_global_are_flagged():
+    src = (
+        "@jax.jit\n"
+        "def step(x, opts={}):\n"
+        "    return x\n")
+    assert _codes(_run(ca.check_retrace_hazard, src)) == ["JL103"]
+    src2 = (
+        "@partial(jax.jit, static_argnums=(1,))\n"
+        "def step(x, n):\n"
+        "    global _SCALE\n"
+        "    return x * _SCALE\n")
+    assert _codes(_run(ca.check_retrace_hazard, src2)) == ["JL103"]
+    # plain decorated function with hashable defaults is clean
+    assert _run(ca.check_retrace_hazard,
+                "@jax.jit\ndef step(x, n=3):\n    return x * n\n") == []
+
+
+# -- JL104 host-sync-hot-loop ----------------------------------------------
+
+def test_host_sync_inside_fit_loop_is_flagged():
+    src = (
+        "def fit(self, xs):\n"
+        "    costs = []\n"
+        "    for x in xs:\n"
+        "        c = self._step(x)\n"
+        "        costs.append(np.asarray(c).tolist())\n"
+        "        c.block_until_ready()\n"
+        "        n = c.item()\n"
+        "    return costs\n")
+    got = _run(ca.check_host_sync, src)
+    assert _codes(got) == ["JL104"] * 3
+
+
+def test_host_sync_outside_loop_or_fit_is_clean():
+    # after the loop: one sync per fit is fine
+    src = ("def fit(self, xs):\n"
+           "    for x in xs:\n"
+           "        c = self._step(x)\n"
+           "    return np.asarray(c)\n")
+    assert _run(ca.check_host_sync, src) == []
+    # not a fit/train path: loaders may asarray per file
+    src2 = ("def load(paths):\n"
+            "    return [np.asarray(read(p)) for p in paths]\n")
+    assert _run(ca.check_host_sync, src2) == []
+    # timing.py is the sanctioned sync site
+    src3 = ("def fit_timed(self, xs):\n"
+            "    for x in xs:\n"
+            "        self._step(x).block_until_ready()\n")
+    assert ca.check_host_sync(ast.parse(src3),
+                              "harp_tpu/benchmark/timing.py", src3) == []
+
+
+# -- JL105 broad-except -----------------------------------------------------
+
+def test_broad_except_variants_are_flagged():
+    src = (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "    try:\n"
+        "        g()\n"
+        "    except (ValueError, BaseException):\n"
+        "        pass\n"
+        "    try:\n"
+        "        g()\n"
+        "    except:\n"
+        "        pass\n")
+    assert _codes(_run(ca.check_broad_except, src)) == ["JL105"] * 3
+    assert _run(ca.check_broad_except,
+                "def f():\n"
+                "    try:\n"
+                "        import scipy\n"
+                "    except ImportError:\n"
+                "        scipy = None\n") == []
+
+
+# -- JL106 scatter (folded lint_scatter) ------------------------------------
+
+def test_scatter_in_hot_tree_flagged_and_cold_tree_exempt():
+    src = "def hot(x, i, v):\n    return x.at[i].add(v)\n"
+    assert _codes(_run(ca.check_scatter, src,
+                       "harp_tpu/models/fake.py")) == ["JL106"]
+    assert _codes(_run(ca.check_scatter, src,
+                       "harp_tpu/ops/fake.py")) == ["JL106"]
+    # gathers and non-hot trees don't trip
+    assert _run(ca.check_scatter, "def f(x, i):\n    return x[i]\n",
+                "harp_tpu/models/fake.py") == []
+    assert _run(ca.check_scatter, src, "harp_tpu/parallel/fake.py") == []
+
+
+# -- allowlist contract -----------------------------------------------------
+
+def test_allowlist_suppresses_and_staleness_fails():
+    f = Finding("JL105", "broad-except", "harp_tpu/models/fake.py", 3,
+                "f", "msg")
+    ok = {("harp_tpu/models/fake.py", "f", "JL105"):
+          "a justification long enough to satisfy the schema"}
+    active, stale = apply_allowlist([f], ok)
+    assert active == [] and stale == []
+    # same entry with no matching finding -> stale, loudly
+    active, stale = apply_allowlist([], ok)
+    assert active == [] and len(stale) == 1 and "prune" in stale[0]
+
+
+def test_allowlist_requires_real_justifications():
+    assert validate_allowlist(
+        {("a.py", "f", "JL105"): "ok"}) != []            # too short
+    assert validate_allowlist({("a.py", "f"): "x" * 40}) != []   # bad key
+    assert validate_allowlist(
+        {("a.py", "f", "JL105"): "cold prepare-side layout, runs once"}
+    ) == []
+
+
+def test_committed_allowlist_is_schema_valid_and_live():
+    assert validate_allowlist(ALLOWLIST) == []
+    raw = run_ast_checkers(REPO, ca.ast_checkers_for_repo(REPO))
+    _active, stale = apply_allowlist(raw, ALLOWLIST)
+    assert stale == [], "\n".join(stale)
+
+
+# -- the repo itself lints clean (tier-1 wiring) ----------------------------
+
+def test_repo_is_clean_under_all_ast_checkers():
+    raw = run_ast_checkers(REPO, ca.ast_checkers_for_repo(REPO))
+    active, _stale = apply_allowlist(raw, ALLOWLIST)
+    assert active == [], "\n".join(str(f) for f in active)
+
+
+# -- jaxpr engine: collective budget + dtype policy -------------------------
+
+def test_traced_budgets_match_committed_manifest(session):
+    # `session` fixture guarantees the 8-device mesh is up; trace_all then
+    # reuses the already-initialized backend
+    traced = checkers_jaxpr.trace_all()
+    findings = checkers_jaxpr.check_budget(REPO, traced)
+    assert findings == [], "\n".join(str(f) for f in findings)
+    # the manifest's collective KINDS are the comm contract: the flagship
+    # regroupallgather variant must stay reduce_scatter+all_gather (+ the
+    # cost psum), not degrade to, e.g., a pair of psums
+    counts, dtype_bad = traced["kmeans_regroupallgather"]
+    assert counts == {"psum": 1, "reduce_scatter": 1, "all_gather": 1}
+    assert dtype_bad == []
+
+
+def test_budget_drift_and_stale_rows_are_loud():
+    traced = {"kmeans_regroupallgather": ({"psum": 5}, [])}
+    findings = checkers_jaxpr.check_budget(REPO, traced)
+    msgs = "\n".join(f.message for f in findings)
+    # count drift on the one traced target...
+    assert any(f.code == "JL201" and "drift" in f.message
+               and f.func == "kmeans_regroupallgather" for f in findings)
+    assert "traced 5 vs pinned 1" in msgs
+    # ...and every other committed row reports as stale/unmatched
+    assert any("matches no trace target" in f.message for f in findings)
+
+
+def test_dtype_policy_reports_bf16_accumulation():
+    import jax
+    import jax.numpy as jnp
+
+    def bad(a, b):
+        return jax.lax.dot_general(a, b, (((1,), (0,)), ((), ())))
+
+    x = jnp.zeros((4, 4), jnp.bfloat16)
+    closed = jax.make_jaxpr(bad)(x, x)
+    counts, dtype_bad = {}, []
+    checkers_jaxpr._walk(closed.jaxpr, counts, dtype_bad)
+    assert any("bf16" in m for m in dtype_bad)
+
+    def good(a, b):
+        return jax.lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+
+    counts, dtype_bad = {}, []
+    checkers_jaxpr._walk(jax.make_jaxpr(good)(x, x).jaxpr, counts, dtype_bad)
+    assert dtype_bad == []

@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# One-exit-code CI gate for harp_tpu (ISSUE 5 satellite):
+#
+#   1. jaxlint      — AST + jaxpr static analysis (collective divergence,
+#                     axis names, retrace hazards, host syncs, broad
+#                     excepts, scatters, collective-budget pinning, dtype
+#                     policy); nonzero on any finding or stale allowlist
+#                     entry.
+#   2. check_claims — README/PERF headline numbers vs BENCH_local.json.
+#   3. tier-1       — the ROADMAP.md verify suite (which itself re-runs
+#                     jaxlint's clean-repo + budget checks as tests, so
+#                     DOTS_PASSED captures them).
+#
+# Any stage failing fails the script; all stages always run (a lint
+# finding must not hide a test regression or vice versa).
+
+set -u
+cd "$(dirname "$0")/.."
+rc=0
+
+echo "== [1/3] jaxlint =="
+python -m tools.jaxlint || rc=1
+
+echo "== [2/3] check_claims =="
+python tools/check_claims.py || rc=1
+
+echo "== [3/3] tier-1 tests =="
+set -o pipefail
+t1_log="$(mktemp /tmp/_t1.XXXXXX.log)"   # unique per run: concurrent CI
+trap 'rm -f "$t1_log"' EXIT              # jobs must not clobber the count
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee "$t1_log" || rc=1
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$t1_log" \
+    | tr -cd . | wc -c)"
+
+if [ "$rc" -ne 0 ]; then
+    echo "ci_checks: FAILED"
+else
+    echo "ci_checks: all stages passed"
+fi
+exit $rc

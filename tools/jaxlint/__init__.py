@@ -1,0 +1,11 @@
+"""jaxlint — static analysis for the harp_tpu training stack.
+
+Run: ``python -m tools.jaxlint`` (AST + jaxpr engines, nonzero exit on any
+finding, stale allowlist entry, or budget drift). See README "Static
+analysis" and tools/jaxlint/core.py for the allowlist contract.
+"""
+
+from tools.jaxlint.core import (  # noqa: F401
+    Finding, apply_allowlist, run_ast_checkers, validate_allowlist,
+)
+from tools.jaxlint.allowlist import ALLOWLIST  # noqa: F401

@@ -1,0 +1,89 @@
+"""jaxlint runner: ``python -m tools.jaxlint [options] [repo_root]``.
+
+Exit status is nonzero on ANY active finding, stale allowlist entry,
+allowlist schema error, or collective-budget drift. ``--update-budget``
+retraces every registry target and rewrites ``tools/collective_budget.json``
+(commit the diff deliberately — it is the per-step communication contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.jaxlint",
+        description="AST + jaxpr static analysis for harp_tpu")
+    parser.add_argument("root", nargs="?", default=None,
+                        help="repo root (default: the checkout this file "
+                             "lives in)")
+    parser.add_argument("--ast-only", action="store_true",
+                        help="skip the jaxpr engine (no model tracing)")
+    parser.add_argument("--jaxpr-only", action="store_true",
+                        help="skip the AST engine")
+    parser.add_argument("--update-budget", action="store_true",
+                        help="retrace all targets and rewrite "
+                             "tools/collective_budget.json")
+    args = parser.parse_args(argv)
+    if args.ast_only and args.jaxpr_only:
+        parser.error("--ast-only and --jaxpr-only are mutually exclusive "
+                     "(together they would check nothing and report clean)")
+    if args.ast_only and args.update_budget:
+        parser.error("--update-budget needs the jaxpr engine; drop "
+                     "--ast-only")
+
+    root = args.root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+
+    from tools.jaxlint.allowlist import ALLOWLIST
+    from tools.jaxlint.checkers_ast import ast_checkers_for_repo
+    from tools.jaxlint.core import (apply_allowlist, run_ast_checkers,
+                                    validate_allowlist)
+
+    problems = 0
+
+    schema_errors = validate_allowlist(ALLOWLIST)
+    for e in schema_errors:
+        print(f"allowlist schema: {e}")
+    problems += len(schema_errors)
+
+    if not args.jaxpr_only:
+        raw = run_ast_checkers(root, ast_checkers_for_repo(root))
+        active, stale = apply_allowlist(raw, ALLOWLIST)
+        for f in active:
+            print(f)
+        for s in stale:
+            print(s)
+        problems += len(active) + len(stale)
+        print(f"ast engine: {len(active)} finding(s), {len(stale)} stale "
+              f"allowlist entr(ies)")
+
+    if not args.ast_only:
+        from tools.jaxlint import checkers_jaxpr
+
+        traced = checkers_jaxpr.trace_all()
+        if args.update_budget:
+            path = checkers_jaxpr.write_budget(root, traced)
+            print(f"wrote {os.path.relpath(path, root)} "
+                  f"({len(traced)} targets)")
+        budget_findings = checkers_jaxpr.check_budget(root, traced)
+        for f in budget_findings:
+            print(f)
+        problems += len(budget_findings)
+        print(f"jaxpr engine: {len(traced)} targets traced, "
+              f"{len(budget_findings)} finding(s)")
+
+    if problems:
+        print(f"jaxlint: {problems} problem(s)")
+        return 1
+    print("jaxlint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

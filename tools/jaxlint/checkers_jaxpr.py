@@ -1,0 +1,186 @@
+"""jaxpr checkers — the traced half of jaxlint.
+
+Codes:
+  JL201 collective-budget   traced collective counts/kinds for a model step
+                            program drifted from the committed manifest
+                            ``tools/collective_budget.json`` (regenerate
+                            deliberately with ``--update-budget`` — the diff
+                            IS the review surface, exactly like check_claims
+                            pins bench numbers).
+  JL202 dtype-policy        a traced program binds a float64/complex128
+                            value (tier-1 runs x64-disabled; an f64 that
+                            appears under x64 would double every collective
+                            payload), or runs a bf16×bf16 dot_general that
+                            ACCUMULATES in bf16 — the repo-wide policy
+                            (ops/lane_pack's exactness contract) is bf16
+                            operands with f32 accumulation
+                            (preferred_element_type), never bf16 sums.
+
+Everything here uses ``jax.make_jaxpr`` only: programs are traced, never
+executed, so the whole budget check runs in tier-1 on the virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from tools.jaxlint.core import Finding
+
+BUDGET_FILE = os.path.join("tools", "collective_budget.json")
+
+# jaxpr primitive names that move bytes across the worker axis. axis_index
+# is deliberately excluded: it reads the device grid, it does not
+# communicate, so it is not part of the budget contract.
+COLLECTIVE_PRIMS = {
+    "psum", "pmin", "pmax", "all_gather", "all_to_all", "reduce_scatter",
+    "psum_scatter", "ppermute", "pshuffle", "pbroadcast", "pgather",
+}
+
+
+def _subjaxprs(eqn):
+    for v in eqn.params.values():
+        items = v if isinstance(v, (list, tuple)) else [v]
+        for item in items:
+            if hasattr(item, "eqns"):
+                yield item
+            elif hasattr(item, "jaxpr") and hasattr(item.jaxpr, "eqns"):
+                yield item.jaxpr
+
+
+def _walk(jaxpr, counts: Dict[str, int], dtype_bad: List[str]) -> None:
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMS:
+            counts[name] = counts.get(name, 0) + 1
+        # dtype policy: no f64/c128 anywhere; bf16 dots must accumulate f32
+        for var in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(var, "aval", None)
+            dt = str(getattr(aval, "dtype", ""))
+            if dt in ("float64", "complex128"):
+                dtype_bad.append(f"{name} binds a {dt} value")
+        if name == "dot_general":
+            in_dts = [str(getattr(getattr(v, "aval", None), "dtype", ""))
+                      for v in eqn.invars]
+            out_dts = [str(getattr(getattr(v, "aval", None), "dtype", ""))
+                       for v in eqn.outvars]
+            if (in_dts and all(d == "bfloat16" for d in in_dts)
+                    and all(d == "bfloat16" for d in out_dts)):
+                dtype_bad.append(
+                    "bf16 x bf16 dot_general accumulating in bf16 — pass "
+                    "preferred_element_type=jnp.float32 (lane_pack "
+                    "exactness contract: bf16 operands, f32 sums)")
+        for sub in _subjaxprs(eqn):
+            _walk(sub, counts, dtype_bad)
+
+
+def trace_target(name: str) -> Tuple[Dict[str, int], List[str]]:
+    """Trace one registry target; returns (collective counts, dtype issues).
+
+    Counts are STATIC occurrences in the traced program. The hot loop of
+    every target is a ``lax.scan`` over iterations, so a collective in the
+    scan body counts once — i.e. the manifest records collectives **per
+    step**, not per run (iteration counts are config, not contract).
+    """
+    import jax
+
+    from tools.jaxlint import trace_targets
+
+    fn, args = trace_targets.TARGETS[name]()
+    closed = jax.make_jaxpr(fn)(*args)
+    counts: Dict[str, int] = {}
+    dtype_bad: List[str] = []
+    _walk(closed.jaxpr, counts, dtype_bad)
+    return counts, dtype_bad
+
+
+def trace_all() -> Dict[str, Tuple[Dict[str, int], List[str]]]:
+    from tools.jaxlint import trace_targets
+
+    trace_targets.ensure_cpu_mesh()
+    return {name: trace_target(name)
+            for name in sorted(trace_targets.TARGETS)}
+
+
+def load_budget(repo_root: str) -> Optional[dict]:
+    path = os.path.join(repo_root, BUDGET_FILE)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def write_budget(repo_root: str,
+                 traced: Dict[str, Tuple[Dict[str, int], List[str]]]) -> str:
+    import jax
+
+    path = os.path.join(repo_root, BUDGET_FILE)
+    doc = {
+        "_contract": (
+            "Collectives-per-step manifest: static collective-primitive "
+            "counts in each model's traced step program at tier-1 shapes "
+            "(tools/jaxlint/trace_targets.py). Tier-1 fails on ANY drift — "
+            "an extra psum per step is a perf regression, a changed kind "
+            "is a changed comm algorithm; regenerate deliberately with "
+            "`python -m tools.jaxlint --update-budget` and review the "
+            "diff. Counts are per STEP (scan bodies count once)."),
+        "traced_with_jax": jax.__version__,
+        "targets": {name: {"collectives": dict(sorted(counts.items()))}
+                    for name, (counts, _bad) in sorted(traced.items())},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return path
+
+
+def check_budget(repo_root: str,
+                 traced: Optional[Dict[str, Tuple[Dict[str, int],
+                                                  List[str]]]] = None,
+                 ) -> List[Finding]:
+    """JL201/JL202 findings for the whole trace registry."""
+    if traced is None:
+        traced = trace_all()
+    findings: List[Finding] = []
+
+    def emit(code, checker, target, msg):
+        findings.append(Finding(
+            code=code, checker=checker, path=BUDGET_FILE, line=1,
+            func=target, message=msg))
+
+    budget = load_budget(repo_root)
+    if budget is None:
+        emit("JL201", "collective-budget", "<manifest>",
+             f"{BUDGET_FILE} is missing — generate it with "
+             f"`python -m tools.jaxlint --update-budget` and commit it")
+        budget_targets = {}
+    else:
+        budget_targets = budget.get("targets", {})
+
+    for name, (counts, dtype_bad) in sorted(traced.items()):
+        for issue in dtype_bad:
+            emit("JL202", "dtype-policy", name, issue)
+        if budget is None:
+            continue
+        if name not in budget_targets:
+            emit("JL201", "collective-budget", name,
+                 f"traced target {name!r} has no manifest entry — run "
+                 f"--update-budget and review the new row")
+            continue
+        pinned = budget_targets[name].get("collectives", {})
+        if dict(counts) != dict(pinned):
+            drift = []
+            for kind in sorted(set(counts) | set(pinned)):
+                got, want = counts.get(kind, 0), pinned.get(kind, 0)
+                if got != want:
+                    drift.append(f"{kind}: traced {got} vs pinned {want}")
+            emit("JL201", "collective-budget", name,
+                 f"collective budget drift ({'; '.join(drift)}) — if "
+                 f"intentional, regenerate with --update-budget and review "
+                 f"the diff; if not, a step gained/lost communication")
+    for name in sorted(set(budget_targets) - set(traced)):
+        emit("JL201", "collective-budget", name,
+             f"manifest entry {name!r} matches no trace target — stale row "
+             f"(target renamed/removed); regenerate with --update-budget")
+    return findings

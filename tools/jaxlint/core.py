@@ -1,0 +1,161 @@
+"""jaxlint core — finding model, file walking, and the shared allowlist.
+
+Two engines share this plumbing (ISSUE 5):
+
+* **AST checkers** (``checkers_ast.py``) walk every ``harp_tpu/`` module and
+  flag patterns that are invisible until a multi-host run hangs: collectives
+  inside rank-conditional branches, unknown collective axis names, retrace
+  hazards, host syncs in hot loops, unjustified broad excepts, and hot-path
+  scatters (folded in from the r6 ``tools/lint_scatter.py``).
+* **jaxpr checkers** (``checkers_jaxpr.py``) trace every model's step
+  function with ``jax.make_jaxpr`` (no execution) and pin the traced
+  collective counts/kinds to ``tools/collective_budget.json`` plus a
+  dtype-policy assert.
+
+Allowlist contract (same rules as the r6 scatter lint, generalized):
+entries are keyed by ``(repo-relative file, enclosing function, code)`` and
+MUST carry a justification string — the next reader learns why the exemption
+is sound. An entry whose key matches no live finding is STALE and fails the
+run: exemptions must be pruned when the exempted code is fixed, or they rot
+into blanket passes for future regressions in that function.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+# Trees the AST engine covers. The scatter checker additionally restricts
+# itself to the device-code hot trees (see checkers_ast.HOT_TREES).
+SCAN_TREE = "harp_tpu"
+
+AllowKey = Tuple[str, str, str]          # (path, function, code)
+Allowlist = Dict[AllowKey, str]          # -> justification (mandatory)
+
+MIN_JUSTIFICATION = 20   # characters; "ok" / "legacy" are not justifications
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic: a (file, line, function) anchored rule violation."""
+
+    code: str       # e.g. "JL101"
+    checker: str    # e.g. "collective-divergence"
+    path: str       # repo-relative, forward slashes
+    line: int
+    func: str       # enclosing function name, or "<module>"
+    message: str
+
+    @property
+    def key(self) -> AllowKey:
+        return (self.path, self.func, self.code)
+
+    def __str__(self) -> str:
+        return (f"{self.path}:{self.line}: {self.code}[{self.checker}] in "
+                f"{self.func}(): {self.message}")
+
+
+class FuncStackVisitor(ast.NodeVisitor):
+    """Visitor that tracks the enclosing-function stack (checkers subclass
+    this; the allowlist is keyed on the innermost enclosing function, the
+    same granularity the scatter lint used)."""
+
+    def __init__(self, rel_path: str):
+        self.rel_path = rel_path
+        self.func_stack: List[str] = []
+        self.findings: List[Finding] = []
+
+    @property
+    def func(self) -> str:
+        return self.func_stack[-1] if self.func_stack else "<module>"
+
+    def _visit_func(self, node):
+        self.func_stack.append(node.name)
+        self.enter_function(node)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def enter_function(self, node) -> None:   # hook for subclasses
+        pass
+
+    def emit(self, code: str, checker: str, node: ast.AST, message: str,
+             func: Optional[str] = None) -> None:
+        self.findings.append(Finding(
+            code=code, checker=checker, path=self.rel_path,
+            line=getattr(node, "lineno", 0),
+            func=self.func if func is None else func, message=message))
+
+
+def iter_py_files(repo_root: str, tree: str = SCAN_TREE,
+                  ) -> Iterable[Tuple[str, str]]:
+    """Yield (repo-relative path, source) for every .py under ``tree``."""
+    base = os.path.join(repo_root, tree)
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            abs_path = os.path.join(dirpath, name)
+            rel = os.path.relpath(abs_path, repo_root).replace(os.sep, "/")
+            with open(abs_path, encoding="utf-8") as f:
+                yield rel, f.read()
+
+
+CheckerFn = Callable[[ast.AST, str, str], List[Finding]]
+
+
+def run_ast_checkers(repo_root: str, checkers: Iterable[CheckerFn],
+                     tree: str = SCAN_TREE) -> List[Finding]:
+    """Raw findings (pre-allowlist) from every checker over every module."""
+    out: List[Finding] = []
+    parsed = [(rel, src, ast.parse(src, filename=rel))
+              for rel, src in iter_py_files(repo_root, tree)]
+    for checker in checkers:
+        for rel, src, mod in parsed:
+            out.extend(checker(mod, rel, src))
+    return sorted(out, key=lambda f: (f.path, f.line, f.code))
+
+
+def validate_allowlist(allowlist: Allowlist) -> List[str]:
+    """Schema errors: malformed keys or missing/too-short justifications."""
+    errors = []
+    for key, why in allowlist.items():
+        if (not isinstance(key, tuple) or len(key) != 3
+                or not all(isinstance(p, str) for p in key)):
+            errors.append(f"allowlist key {key!r} is not a "
+                          f"(file, function, code) string triple")
+            continue
+        if not isinstance(why, str) or len(why.strip()) < MIN_JUSTIFICATION:
+            errors.append(
+                f"allowlist entry {key[0]}::{key[1]}::{key[2]} needs a real "
+                f"justification (>= {MIN_JUSTIFICATION} chars), got "
+                f"{why!r}")
+    return errors
+
+
+def apply_allowlist(raw: List[Finding], allowlist: Allowlist,
+                    ) -> Tuple[List[Finding], List[str]]:
+    """Split raw findings into (active, stale-entry errors).
+
+    A finding whose (path, func, code) is allowlisted is suppressed; an
+    allowlist entry matching NO raw finding is stale and reported — the
+    exempted code was fixed, so the exemption must be pruned (otherwise it
+    silently pre-approves the next violation in that function).
+    """
+    # malformed keys are reported by validate_allowlist; skip them here so
+    # one bad entry can't crash the run and hide every other finding
+    wellformed = {k for k in allowlist
+                  if isinstance(k, tuple) and len(k) == 3
+                  and all(isinstance(p, str) for p in k)}
+    live_keys = {f.key for f in raw}
+    active = [f for f in raw if f.key not in wellformed]
+    stale = [f"stale allowlist entry (no {code} finding in {path}::{func} "
+             f"anymore — prune it)"
+             for (path, func, code) in sorted(wellformed)
+             if (path, func, code) not in live_keys]
+    return active, stale

@@ -1,0 +1,189 @@
+"""Model step-function trace registry for the jaxpr engine.
+
+Each target builds a model at TIER-1 shapes (the same tiny configs the test
+suite runs on the 8-worker virtual CPU mesh) and returns the compiled step
+callable plus already-placed inputs, so ``jax.make_jaxpr`` can trace the
+whole training program WITHOUT executing it. The traced collective counts
+are what ``tools/collective_budget.json`` pins — an extra psum per step (or
+a variant silently changing its collective kind) is a performance-contract
+drift exactly like a bench-number regression (arXiv:2112.01075 treats
+per-step collective counts as a first-class redistribution contract).
+
+Prepare-side work DOES run on host+device (tiny device_puts); the step
+program itself is only traced. Keep shapes small — every target is traced
+in tier-1.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Callable, Dict, Tuple
+
+NUM_WORKERS = 8
+
+
+def ensure_cpu_mesh() -> None:
+    """Force the tier-1 tracing platform: 8 virtual CPU devices.
+
+    Mirrors tests/conftest.py. Must run before jax initializes a backend;
+    inside pytest the conftest has already done the identical setup.
+    """
+    if "jax" not in sys.modules:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{NUM_WORKERS}").strip()
+    import jax
+
+    # the image's sitecustomize force-selects the TPU backend via
+    # jax.config — override back before any backend initializes (conftest
+    # does the same); tracing must not hold a real accelerator
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", False)
+    if len(jax.devices()) < NUM_WORKERS:
+        raise RuntimeError(
+            f"jaxlint tracing needs {NUM_WORKERS} virtual CPU devices but "
+            f"found {len(jax.devices())} — jax initialized before "
+            f"ensure_cpu_mesh() could set XLA_FLAGS")
+
+
+def _session():
+    from harp_tpu.session import HarpSession
+
+    return HarpSession(num_workers=NUM_WORKERS)
+
+
+def _rng():
+    import numpy as np
+
+    return np.random.default_rng(0)
+
+
+# -- builders: () -> (callable, args) --------------------------------------
+
+
+def _kmeans(comm: str):
+    def build():
+        from harp_tpu.models import kmeans as km
+
+        sess = _session()
+        model = km.KMeans(sess, km.KMeansConfig(8, 16, iterations=2,
+                                                comm=comm))
+        rng = _rng()
+        pts = rng.normal(size=(64, 16)).astype("float32")
+        p, c = model.prepare(pts, pts[:8].copy())
+        return model._fit, (p, c)
+
+    return build
+
+
+def _lda():
+    from harp_tpu.models import lda
+
+    sess = _session()
+    model = lda.LDA(sess, lda.LDAConfig(num_topics=4, vocab=96, epochs=2))
+    docs = _rng().integers(0, 96, size=(16, 12))
+    key, data, seed, _meta = model.prepare(docs, seed=0)
+    return model._fns[key], (*data, seed)
+
+
+def _lda_subblock():
+    from harp_tpu.models import lda
+
+    sess = _session()
+    model = lda.LDA(sess, lda.LDAConfig(num_topics=4, vocab=2048, epochs=2,
+                                        vocab_sub_block=128))
+    docs = _rng().integers(0, 2048, size=(16, 12))
+    key, data, seed, _meta = model.prepare(docs, seed=0)
+    return model._fns[key], (*data, seed)
+
+
+def _sgd_mf():
+    from harp_tpu.models import sgd_mf
+
+    sess = _session()
+    cfg = sgd_mf.SGDMFConfig(rank=8, lam=0.01, lr=0.1, epochs=2,
+                             minibatches_per_hop=2)
+    model = sgd_mf.SGDMF(sess, cfg)
+    rng = _rng()
+    n = 400
+    rows = rng.integers(0, 64, size=n)
+    cols = rng.integers(0, 48, size=n)
+    vals = rng.normal(size=n).astype("float32")
+    layout, data, w0, h0, meta = model.prepare(rows, cols, vals, 64, 48)
+    key = model._program(layout, cfg.minibatches_per_hop, cfg.epochs,
+                         meta[6])
+    return model._compiled[key], (*data, w0, h0)
+
+
+def _als():
+    from harp_tpu.models import als
+
+    sess = _session()
+    cfg = als.ALSConfig(rank=8, lam=0.05, iterations=2, implicit=False)
+    model = als.ALS(sess, cfg)
+    rng = _rng()
+    n = 400
+    rows = rng.integers(0, 80, size=n)
+    cols = rng.integers(0, 64, size=n)
+    vals = rng.normal(size=n).astype("float32")
+    key, placed, _, _ = model.prepare(rows, cols, vals, 80, 64)
+    return model._fns[key], placed
+
+
+def _pagerank():
+    from harp_tpu.models import pagerank as pr
+
+    sess = _session()
+    cfg = pr.PageRankConfig(iterations=2)
+    rng = _rng()
+    n_edges, n_vertices = 200, 64
+    src = rng.integers(0, n_vertices, size=n_edges).astype("int32")
+    dst = rng.integers(0, n_vertices, size=n_edges).astype("int32")
+    nbr, mask, deg = pr.pad_out_edges(src, dst, n_vertices, sess.num_workers)
+    v_pad = nbr.shape[0]
+    fn = sess.spmd(
+        lambda a, b, c: pr._pagerank(a, b, c, n_vertices, v_pad, cfg),
+        in_specs=(sess.shard(),) * 3,
+        out_specs=(sess.replicate(), sess.replicate()))
+    return fn, (sess.scatter(nbr), sess.scatter(mask), sess.scatter(deg))
+
+
+def _nn():
+    import jax.numpy as jnp
+
+    from harp_tpu.models import nn
+
+    sess = _session()
+    cfg = nn.NNConfig(layers=(8,), num_classes=3, lr=0.1, batch_size=8,
+                      epochs=2)
+    rng = _rng()
+    x = rng.normal(size=(64, 10)).astype("float32")
+    y = rng.integers(0, 3, size=64).astype("int32")
+    params0 = nn.init_params((10, 8, 3), seed=0)
+    fn = sess.spmd(
+        lambda a, t, p: nn._train(a, t, p, cfg),
+        in_specs=(sess.shard(), sess.shard(), sess.replicate()),
+        out_specs=(sess.replicate(), sess.replicate()))
+    return fn, (sess.scatter(jnp.asarray(x)), sess.scatter(jnp.asarray(y)),
+                params0)
+
+
+# Registry: target name -> builder returning (traceable callable, args).
+# Names are the manifest keys — renaming one is a manifest change.
+TARGETS: Dict[str, Callable[[], Tuple[Callable, tuple]]] = {
+    "kmeans_regroupallgather": _kmeans("regroupallgather"),
+    "kmeans_allreduce": _kmeans("allreduce"),
+    "kmeans_pushpull": _kmeans("pushpull"),
+    "kmeans_bcastreduce": _kmeans("bcastreduce"),
+    "kmeans_rotation": _kmeans("rotation"),
+    "lda_cgs": _lda,
+    "lda_cgs_subblock128": _lda_subblock,
+    "sgd_mf_dense": _sgd_mf,
+    "als_explicit": _als,
+    "pagerank": _pagerank,
+    "nn_mlp": _nn,
+}

@@ -1,70 +1,40 @@
 #!/usr/bin/env python
-"""Hot-path scatter lint: flag ``.at[...].add/.set/...`` in device code.
+"""Legacy CLI shim for the hot-path scatter lint — now jaxlint's JL106.
 
-XLA lowers indexed updates to the TPU scatter unit, which serializes at
-~8.5 ns per 128-byte row — measured 8.8× slower than the one-hot-GEMM form
-on the CSR K-means densify and 82% of the whole LDA hop before the r5 fix
-(PERF.md). Every hot path in this repo therefore routes scatters through
-``harp_tpu/ops/lane_pack.py`` (gemm_scatter / densify_rows); a NEW
-``.at[...].add`` in ``harp_tpu/models/`` or ``harp_tpu/ops/`` is far more
-likely to be a perf bug than a deliberate choice.
+The r6 standalone checker was folded into ``tools/jaxlint`` (ISSUE 5): the
+scatter rule lives in ``tools/jaxlint/checkers_ast.py::check_scatter`` and
+its exemptions moved — same functions, same reasons — into the shared
+``tools/jaxlint/allowlist.py`` keyed ``(file, function, "JL106")``. This
+shim keeps the old entry points working:
 
-This checker walks the AST of both trees and reports every indexed-update
-call that is not on the explicit allowlist below. Cold paths that
-legitimately scatter (one-time prepare-side layout, O(K)-sized solver
-bookkeeping, gated legacy strategies kept for very-sparse regimes) are
-allowlisted **by (file, enclosing function)** with the reason inline — so
-the next reader knows why each exemption is sound, and a new scatter in an
-allowlisted FILE but a different function still trips the lint.
+* ``python tools/lint_scatter.py [repo_root]`` — same CLI, same exit codes;
+* ``check`` / ``stale_allowlist_entries`` / ``_scan_source`` / ``ALLOWLIST``
+  — the API ``tests/test_lint_scatter.py`` exercises.
 
-Usage: ``python tools/lint_scatter.py [repo_root]`` — exits nonzero on any
-violation. ``tests/test_lint_scatter.py`` runs it in tier-1.
+New exemptions go in tools/jaxlint/allowlist.py, not here.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
-from typing import List, NamedTuple, Optional
+from typing import List, NamedTuple
 
-# indexed-update methods XLA lowers to scatter ops
-_SCATTER_METHODS = {"add", "set", "mul", "divide", "min", "max", "power",
-                    "apply"}
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-# directories under the repo root whose device code the lint covers
-HOT_TREES = (os.path.join("harp_tpu", "models"),
-             os.path.join("harp_tpu", "ops"))
+from tools.jaxlint import checkers_ast as _ca              # noqa: E402
+from tools.jaxlint.allowlist import ALLOWLIST as _SHARED   # noqa: E402
+from tools.jaxlint.core import (iter_py_files,             # noqa: E402
+                                run_ast_checkers)
 
-# (relative path, enclosing function) -> why the scatter is legitimate.
-# Everything here is COLD (runs once per prepare/build, not per iteration)
-# or an explicitly-gated legacy strategy whose hot replacement exists.
-ALLOWLIST = {
-    ("harp_tpu/models/sgd_mf.py", "densify"):
-        "prepare-time slab densification: runs ONCE per layout, scatters "
-        "into a slab too wide for a one-hot GEMM (slab_elems lanes); the "
-        "per-epoch hot path is pure stripe GEMMs",
-    ("harp_tpu/models/sgd_mf.py", "mb_step"):
-        "legacy layout='sparse' minibatch update, kept for data too large "
-        "to densify; documented ~25M samples/s gather/scatter wall — the "
-        "dense masked-stripe layout IS the hot path",
-    ("harp_tpu/models/sparse.py", "sparse_kmeans_stats"):
-        "strategy='gather' phantom-count correction: the gated legacy "
-        "strategy for very-sparse-very-wide data (default is the "
-        "lane_pack densify-GEMM, 13x faster on the bench shape)",
-    ("harp_tpu/models/solvers.py", "bwd"):
-        "L-BFGS two-loop recursion alpha write: O(history) scalars per "
-        "OUTER optimizer step, not per-sample work",
-    ("harp_tpu/models/solvers.py", "step"):
-        "L-BFGS (s, y, rho) ring-buffer history write: O(history) rows "
-        "per outer step",
-    ("harp_tpu/models/forest.py", "one_tree"):
-        "per-tree feature mask init: O(dim) bits once per tree build, "
-        "never inside the per-sample scoring loop",
-    ("harp_tpu/ops/linalg.py", "body"):
-        "distributed-sort permutation bookkeeping: O(W) control-plane "
-        "rows per merge round, not data-plane traffic",
-}
+# The legacy (file, function) -> reason view of the shared JL106 entries.
+ALLOWLIST = {(path, func): why
+             for (path, func, code), why in _SHARED.items()
+             if code == "JL106"}
+
+HOT_TREES = _ca.HOT_TREES
 
 
 class Violation(NamedTuple):
@@ -77,104 +47,44 @@ class Violation(NamedTuple):
         return (f"{self.path}:{self.line}: .at[...].{self.method} in "
                 f"{self.func}() — route through ops/lane_pack "
                 f"(gemm_scatter/densify_rows) or allowlist it in "
-                f"tools/lint_scatter.py with a reason")
-
-
-def _is_at_indexed_update(node: ast.Call) -> Optional[str]:
-    """Matches ``<expr>.at[<idx>].<method>(...)``; returns the method name."""
-    f = node.func
-    if not (isinstance(f, ast.Attribute) and f.attr in _SCATTER_METHODS):
-        return None
-    sub = f.value
-    if not isinstance(sub, ast.Subscript):
-        return None
-    base = sub.value
-    if isinstance(base, ast.Attribute) and base.attr == "at":
-        return f.attr
-    return None
+                f"tools/jaxlint/allowlist.py with a reason")
 
 
 def _scan_source(src: str, rel_path: str) -> List[Violation]:
+    import ast
+
     tree = ast.parse(src, filename=rel_path)
-    out: List[Violation] = []
-
-    func_stack: List[str] = []
-
-    class V(ast.NodeVisitor):
-        def _visit_func(self, node):
-            func_stack.append(node.name)
-            self.generic_visit(node)
-            func_stack.pop()
-
-        visit_FunctionDef = _visit_func
-        visit_AsyncFunctionDef = _visit_func
-
-        def visit_Call(self, node):
-            m = _is_at_indexed_update(node)
-            if m is not None:
-                func = func_stack[-1] if func_stack else "<module>"
-                if (rel_path, func) not in ALLOWLIST:
-                    out.append(Violation(rel_path, node.lineno, func, m))
-            self.generic_visit(node)
-
-    V().visit(tree)
+    out = []
+    for f in _ca.check_scatter(tree, rel_path, src):
+        if (f.path, f.func) in ALLOWLIST:
+            continue
+        # each finding's message leads with its own ".at[...].<method>"
+        # token, so the method is exact even with several updates per line
+        method = f.message.split(" ", 1)[0].rsplit(".", 1)[1]
+        out.append(Violation(f.path, f.line, f.func, method))
     return out
 
 
 def check(repo_root: str) -> List[Violation]:
     """Scan the hot trees; returns all un-allowlisted indexed updates."""
-    violations: List[Violation] = []
-    for tree_rel in HOT_TREES:
-        tree_abs = os.path.join(repo_root, tree_rel)
-        for name in sorted(os.listdir(tree_abs)):
-            if not name.endswith(".py"):
-                continue
-            abs_path = os.path.join(tree_abs, name)
-            rel = os.path.join(tree_rel, name).replace(os.sep, "/")
-            with open(abs_path, encoding="utf-8") as f:
-                violations.extend(_scan_source(f.read(), rel))
-    return violations
+    out = []
+    for rel, src in iter_py_files(repo_root):
+        if rel.startswith(HOT_TREES):
+            out.extend(_scan_source(src, rel))
+    return out
 
 
 def stale_allowlist_entries(repo_root: str) -> List[str]:
-    """Allowlist rows whose (file, function) no longer scatters — entries
-    must be pruned when the exempted code is fixed, or they rot into
-    blanket exemptions."""
-    live = set()
-    for tree_rel in HOT_TREES:
-        tree_abs = os.path.join(repo_root, tree_rel)
-        for name in sorted(os.listdir(tree_abs)):
-            if not name.endswith(".py"):
-                continue
-            rel = os.path.join(tree_rel, name).replace(os.sep, "/")
-            with open(os.path.join(tree_abs, name), encoding="utf-8") as f:
-                src = f.read()
-            tree = ast.parse(src, filename=rel)
-            stack: List[str] = []
-
-            class V(ast.NodeVisitor):
-                def _visit_func(self, node):
-                    stack.append(node.name)
-                    self.generic_visit(node)
-                    stack.pop()
-
-                visit_FunctionDef = _visit_func
-                visit_AsyncFunctionDef = _visit_func
-
-                def visit_Call(self, node):
-                    if _is_at_indexed_update(node) is not None:
-                        live.add((rel, stack[-1] if stack else "<module>"))
-                    self.generic_visit(node)
-
-            V().visit(tree)
+    """Allowlist rows whose (file, function) no longer scatters."""
+    live = {(f.path, f.func)
+            for f in run_ast_checkers(repo_root, [_ca.check_scatter])}
     return [f"{p}::{fn}" for (p, fn) in sorted(ALLOWLIST)
             if (p, fn) not in live]
 
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
-    root = argv[0] if argv else os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))
+    root = argv[0] if argv else _REPO
     violations = check(root)
     for v in violations:
         print(str(v))
