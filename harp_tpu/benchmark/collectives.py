@@ -14,17 +14,21 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Dict, List
+from typing import Dict, List, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from harp_tpu.collectives import lax_ops
+from harp_tpu.collectives import lax_ops, quantize, rotation
+from harp_tpu.parallel import mesh as mesh_lib
 from harp_tpu.session import HarpSession
 
 OPS = ("broadcast", "reduce", "allreduce", "allgather", "reduce_scatter",
        "rotate", "all_to_all")
+
+# codecs the quantized rows compare (None = the f32 baseline wire format)
+QUANT_CODECS = (None, "int8", "bf16")
 
 
 # what the emitted numbers MEAN — ships inside every record so cross-round
@@ -113,10 +117,52 @@ def _op_fn(op: str):
             return jnp.tile(out, (n,) + (1,) * (x.ndim - 1))
         return rs
     if op == "rotate":
-        return lambda x: lax_ops.rotate(x, 1)
+        # link-class aware: a DCN-hinted worker axis chunks the hop so
+        # pieces pipeline over the slow link (mesh.set_axis_link_class);
+        # the default ICI hint keeps the single monolithic permute
+        link = mesh_lib.axis_link_class(lax_ops.WORKERS)
+        return lambda x: lax_ops.rotate(
+            x, 1, num_chunks=rotation.chunks_for_link(
+                x.size * x.dtype.itemsize, link))
     if op == "all_to_all":
         return lax_ops.all_to_all
     raise ValueError(f"unknown op {op}")
+
+
+def _time_point(session: HarpSession, fn, kb: int, loops: int
+                ) -> Tuple[int, float]:
+    """One measurement-grid point, the SHARED harness for the f32 and
+    quantized tables (so codec deltas are wire-format, never harness,
+    differences): in-program scan loop with a dependency chain, compile +
+    warm-up before the timed region, median-of-3, no D2H while timing.
+    Returns (per-worker payload bytes, median seconds for ``loops`` ops)."""
+    n_floats = kb * 1024 // 4
+    # rows must divide into W local rows AND those must re-divide by W
+    # for reduce_scatter/all_to_all (block transpose) → multiple of W²
+    w2 = session.num_workers ** 2
+    rows = max(w2, n_floats // 128 // w2 * w2)
+    x = np.ones((rows, 128), np.float32)
+
+    def looped(a):
+        def body(c, _):
+            out = fn(c)
+            return out * 0.999 + c * 0.001, None  # dependency chain
+        out, _ = jax.lax.scan(body, a, None, length=loops)
+        return out
+
+    prog = session.spmd(looped, in_specs=(session.shard(),),
+                        out_specs=session.shard())
+    dev = session.scatter(x)
+    np.asarray(prog(dev))                   # compile + warm-up (D2H ok)
+    samples = []
+    for _ in range(3):                      # median-of-3 (r5 rigor pass)
+        t0 = time.perf_counter()
+        jax.block_until_ready(prog(dev))    # no D2H in timed region
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    # the PER-WORKER payload (the local block each collective actually
+    # operates on); _bytes_moved is defined in those terms
+    return x.nbytes // session.num_workers, samples[1]
 
 
 def bench_collectives(
@@ -130,35 +176,81 @@ def bench_collectives(
     for op in ops:
         fn = _op_fn(op)
         for kb in sizes_kb:
-            n_floats = kb * 1024 // 4
-            # rows must divide into W local rows AND those must re-divide by W
-            # for reduce_scatter/all_to_all (block transpose) → multiple of W²
-            w2 = session.num_workers ** 2
-            rows = max(w2, n_floats // 128 // w2 * w2)
-            x = np.ones((rows, 128), np.float32)
+            payload, sec = _time_point(session, fn, kb, loops)
+            results.append(BenchResult(op, payload, loops, sec,
+                                       session.num_workers))
+    return results
 
-            def looped(a):
-                def body(c, _):
-                    out = fn(c)
-                    return out * 0.999 + c * 0.001, None  # dependency chain
-                out, _ = jax.lax.scan(body, a, None, length=loops)
-                return out
 
-            prog = session.spmd(looped, in_specs=(session.shard(),),
-                                out_specs=session.shard())
-            dev = session.scatter(x)
-            np.asarray(prog(dev))               # compile + warm-up (D2H ok)
-            samples = []
-            for _ in range(3):                  # median-of-3 (r5 rigor pass)
-                t0 = time.perf_counter()
-                jax.block_until_ready(prog(dev))  # no D2H in timed region
-                samples.append(time.perf_counter() - t0)
-            samples.sort()
-            best = samples[1]                   # the median
-            # the PER-WORKER payload (the local block each collective
-            # actually operates on); _bytes_moved is defined in those terms
-            results.append(BenchResult(op, x.nbytes // session.num_workers,
-                                       loops, best, session.num_workers))
+def _quant_bytes_moved(op: str, payload_bytes: int, w: int,
+                       codec) -> float:
+    """Per-worker bytes MOVED by the quantized lowering of each op (the
+    busbw numerator — same NCCL-tests convention as :func:`_bytes_moved`,
+    priced at the QUANTIZED wire format including int8's scale overhead).
+
+      allreduce  two-stage: all_to_all of (W-1)/W·S_q + all_gather of
+                 (W-1)/W·S_q  →  2(W-1)/W · S_q
+      rotate     one encoded block send/recv → S_q
+
+    int8's amortized scale cost depends on the EFFECTIVE block, which
+    ``allreduce_q`` sizes per destination chunk (n/W elements) while
+    ``rotate_q`` sizes over the whole block — priced accordingly so small
+    payloads (where blocks adapt below 256) aren't under-charged.
+    """
+    n = payload_bytes // 4
+    comm = quantize.CommConfig(quant=codec) if codec else None
+    per_elem = quantize.wire_bytes_per_element(
+        comm, max(1, n // w) if op == "allreduce" else n)
+    s_q = n * per_elem
+    if op == "rotate":
+        return s_q
+    if op == "allreduce":
+        return 2.0 * s_q * (w - 1) / w
+    raise ValueError(f"unknown quantized op {op}")
+
+
+def bench_collectives_quantized(
+    session: HarpSession,
+    sizes_kb: List[int] = (64, 1024),
+    loops: int = 20,
+) -> List[dict]:
+    """busbw rows for the QUANTIZED hot hops: allreduce + the rotation hop,
+    each at int8/bf16/f32, ≥2 payload sizes (ISSUE 6 satellite). Same
+    measurement protocol as :func:`bench_collectives` (in-program scan loop,
+    median-of-3); the f32 rows use the identical harness so the codec
+    deltas are wire-format, not harness, differences. Records ship the
+    ``payload_bytes_per_worker``/``busbw_gbps`` convention + which link
+    class the session's worker axis is hinted as."""
+    link = mesh_lib.axis_link_class(lax_ops.WORKERS)
+    results = []
+    for codec in QUANT_CODECS:
+        comm = quantize.CommConfig(quant=codec) if codec else None
+        for op in ("allreduce", "rotate"):
+            if op == "allreduce":
+                def fn(x, _comm=comm):
+                    return lax_ops.allreduce(x, comm=_comm)
+            else:
+                def fn(x, _comm=comm):
+                    return lax_ops.rotate(
+                        x, 1, comm=_comm,
+                        num_chunks=rotation.chunks_for_link(
+                            x.size * x.dtype.itemsize, link))
+            for kb in sizes_kb:
+                payload, sec = _time_point(session, fn, kb, loops)
+                moved = (_quant_bytes_moved(op, payload,
+                                            session.num_workers, codec)
+                         if codec else
+                         _bytes_moved(op, payload, session.num_workers))
+                results.append({
+                    "op": op,
+                    "codec": codec or "f32",
+                    "payload_bytes_per_worker": payload,
+                    "us_per_op": round(sec / loops * 1e6, 1),
+                    "busbw_gbps": round(moved / (sec / loops) / 1e9, 3),
+                    "link_class": link,
+                    "num_workers": session.num_workers,
+                    "convention": CONVENTION_NOTE,
+                })
     return results
 
 

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 
 from harp_tpu import compat
 from harp_tpu import combiner as combiner_lib
+from harp_tpu.collectives import quantize
 from harp_tpu.parallel.mesh import WORKERS
 
 
@@ -48,12 +49,22 @@ def allreduce(
     x: jax.Array,
     combiner: combiner_lib.Combiner = combiner_lib.SUM,
     axis_name: str = WORKERS,
-) -> jax.Array:
+    comm: Optional[quantize.CommConfig] = None,
+    residual: Optional[jax.Array] = None,
+):
     """All workers end with the combined value.
 
     Reference: AllreduceCollective.allreduce:150 (recursive halving/doubling).
-    """
-    return combiner.psum_like(x, axis_name)
+
+    ``comm`` (opt-in, quantize.CommConfig): int8/bf16 wire format via the
+    two-stage quantized decomposition — dequantize-after-transport, f32
+    accumulation (collectives/quantize.py). When ``residual`` is passed
+    (error-feedback state shaped like x) the return is ``(out, residual')``
+    — also on the f32 path, so call sites stay uniform."""
+    if comm is not None and comm.active:
+        return quantize.allreduce_q(x, combiner, axis_name, comm, residual)
+    out = combiner.psum_like(x, axis_name)
+    return (out, residual) if residual is not None else out
 
 
 def reduce(
@@ -83,11 +94,16 @@ def broadcast(x: jax.Array, root: int = 0, axis_name: str = WORKERS) -> jax.Arra
     return jax.lax.psum(jnp.where(mask, x, jnp.zeros_like(x)), axis_name)
 
 
-def allgather(x: jax.Array, axis_name: str = WORKERS, tiled: bool = True) -> jax.Array:
+def allgather(x: jax.Array, axis_name: str = WORKERS, tiled: bool = True,
+              comm: Optional[quantize.CommConfig] = None) -> jax.Array:
     """Concatenate every worker's block along axis 0 (ring allgather).
 
     Reference: AllgatherCollective.allgather:147 (send-to-next ring relay).
+    ``comm``: opt-in quantized wire format (stateless — every worker decodes
+    the same payload, so the gathered result stays replicated-consistent).
     """
+    if comm is not None and comm.active:
+        return quantize.allgather_q(x, axis_name, comm, tiled=tiled)
     return jax.lax.all_gather(x, axis_name, tiled=tiled)
 
 
@@ -103,7 +119,9 @@ def reduce_scatter(
     x: jax.Array,
     combiner: combiner_lib.Combiner = combiner_lib.SUM,
     axis_name: str = WORKERS,
-) -> jax.Array:
+    comm: Optional[quantize.CommConfig] = None,
+    residual: Optional[jax.Array] = None,
+):
     """Combine per-worker contributions and scatter blocks: worker w gets the
     combined block w of the partition axis.
 
@@ -111,7 +129,16 @@ def reduce_scatter(
     (RegroupCollective.regroupCombine:154: partitioner → P2P dispatch → combine on
     arrival). SUM/AVG lower to ``psum_scatter``; other algebras lower to
     ``all_to_all`` + a local combine (XLA has no reduce_scatter for max/min).
+
+    ``comm``/``residual``: opt-in quantized wire format + error-feedback
+    state, same contract as :func:`allreduce` (SUM/AVG only).
     """
+    if comm is not None and comm.active:
+        return quantize.reduce_scatter_q(x, combiner, axis_name, comm,
+                                         residual)
+    if residual is not None:
+        out = reduce_scatter(x, combiner, axis_name)
+        return out, residual
     n = compat.axis_size(axis_name)
     if combiner.op in (combiner_lib.Op.SUM, combiner_lib.Op.AVG):
         out = jax.lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
@@ -126,21 +153,56 @@ def reduce_scatter(
     return combiner.tree_combine(exchanged, axis=0)
 
 
-def rotate(x: jax.Array, steps: int = 1, axis_name: str = WORKERS) -> jax.Array:
+def rotate(x: jax.Array, steps: int = 1, axis_name: str = WORKERS,
+           comm: Optional[quantize.CommConfig] = None,
+           num_chunks: int = 1) -> jax.Array:
     """Ring-shift this worker's block to ``(id + steps) % n`` — i.e. each worker
     receives the block previously held by ``id - steps``.
 
     Reference: LocalGlobalSyncCollective.rotate:710 (ring or custom rotateMap).
     Lowered to ``ppermute`` which maps 1:1 onto neighbor ICI links.
+
+    ``comm``: opt-in quantized wire format (stateless; rotation loops carry
+    error feedback in ``rotation.rotate_scan``'s carry instead).
+    ``num_chunks`` > 1 splits the block into that many ppermutes along axis
+    0 — DCN-hop pipelining (``rotation.chunks_for_link``): XLA's async
+    collective scheduler overlaps in-flight chunks over a slow link, where
+    one monolithic permute would serialize behind the first byte.
     """
+    if comm is not None and comm.active:
+        # chunking composes with quantization at the whole-block level: the
+        # encode is one program either way, and a quantized DCN hop is
+        # already 2-4x smaller than the chunking threshold assumes
+        return quantize.rotate_q(x, steps, axis_name, comm)
     n = compat.axis_size(axis_name)
     perm = [(i, (i + steps) % n) for i in range(n)]
+    if num_chunks > 1 and x.ndim and x.shape[0] > 1:
+        parts = jnp.array_split(x, min(num_chunks, x.shape[0]), axis=0)
+        return jnp.concatenate(
+            [jax.lax.ppermute(p, axis_name, perm) for p in parts], axis=0)
     return jax.lax.ppermute(x, axis_name, perm)
 
 
 def rotate_map(x: jax.Array, mapping: dict, axis_name: str = WORKERS) -> jax.Array:
     """Rotate with an explicit worker→worker map (Harp's rotateMap Int2IntMap,
-    LocalGlobalSyncCollective.rotateGlobal:746)."""
+    LocalGlobalSyncCollective.rotateGlobal:746).
+
+    ``mapping`` must be a bijection over the whole axis: ``ppermute`` sends
+    nothing for missing sources and delivers ZEROS to unnamed destinations,
+    so a malformed map would silently drop shards — validate loudly instead.
+    """
+    n = compat.axis_size(axis_name)
+    srcs, dsts = set(mapping.keys()), set(mapping.values())
+    expect = set(range(n))
+    if srcs != expect or dsts != expect:
+        missing_src = sorted(expect - srcs)
+        missing_dst = sorted(expect - dsts)
+        bad = sorted((srcs | dsts) - expect)
+        raise ValueError(
+            f"rotate_map mapping must be a bijection over all {n} workers: "
+            f"sources missing {missing_src}, destinations missing "
+            f"{missing_dst}, out-of-range ids {bad} — a partial map would "
+            f"silently replace the unnamed workers' shards with zeros")
     perm = sorted(mapping.items())
     return jax.lax.ppermute(x, axis_name, perm)
 

@@ -19,20 +19,106 @@ data-dependent — hostile to XLA. Per SURVEY §7 "hard parts", it is reformulat
 **bounded-staleness fixed block schedule**: a fixed number of randomly-permuted block
 updates per rotation hop (seeded, reproducible). Convergence-equivalent, not
 step-equivalent; see models/sgd_mf.py.
+
+Wire-format options (this layer owns the hot hops, so both live here):
+
+* ``comm`` (quantize.CommConfig): int8/bf16 quantized hops with
+  **error-feedback state carried in the scan carry** — each sender keeps the
+  residual its last encode failed to carry and adds it to the next outgoing
+  block (EF-ring: the time-average of the fed-back error vanishes). Only
+  float32 leaves are quantized; integer/bool leaves ride the wire exact.
+* ``link_class`` ("ici" | "dcn", default: the mesh-axis hint,
+  ``parallel.mesh.axis_link_class``): a DCN hop splits its payload into
+  ~``DCN_CHUNK_BYTES`` ppermute chunks so in-flight pieces pipeline over the
+  slow link; an ICI hop stays one monolithic permute (the extra dispatches
+  would only cost latency on a fabric that is already one hop wide).
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Tuple, TypeVar
+from typing import Any, Callable, Optional, Tuple, TypeVar
 
 import jax
 import jax.numpy as jnp
 
-from harp_tpu.collectives import lax_ops
+from harp_tpu.collectives import lax_ops, quantize
+from harp_tpu.parallel import mesh as mesh_lib
 from harp_tpu.parallel.mesh import WORKERS
 
 Carry = TypeVar("Carry")
 Slice = Any  # pytree of arrays — one model slice's per-worker block
+
+# DCN rotation hops pipeline in ~1 MiB pieces (big enough to amortize
+# per-message overhead on a data-center link, small enough that several are
+# in flight); capped at 8 chunks so tiny payloads don't shatter.
+DCN_CHUNK_BYTES = 1 << 20
+MAX_DCN_CHUNKS = 8
+
+
+def chunks_for_link(nbytes: int, link_class: str) -> int:
+    """ppermute chunk count for one rotation hop of ``nbytes`` payload."""
+    if link_class == "dcn":
+        return max(1, min(MAX_DCN_CHUNKS, -(-nbytes // DCN_CHUNK_BYTES)))
+    return 1
+
+
+def _resolve_link(link_class: Optional[str], axis_name: str) -> str:
+    return (link_class if link_class is not None
+            else mesh_lib.axis_link_class(axis_name))
+
+
+def _leaf_bytes(x) -> int:
+    return int(x.size) * x.dtype.itemsize
+
+
+def _quantizable(leaf) -> bool:
+    return jnp.issubdtype(leaf.dtype, jnp.floating)
+
+
+def _ef_zero(block: Slice):
+    """EF residual tree for a block: f32 zeros for float leaves, None-like
+    zeros (unused) for non-float leaves so tree structures stay aligned."""
+    return jax.tree.map(
+        lambda a: jnp.zeros(a.shape, jnp.float32) if _quantizable(a)
+        else jnp.zeros((), jnp.float32), block)
+
+
+def _shift_block(block: Slice, res: Optional[Slice], shift: int,
+                 axis_name: str, comm: Optional[quantize.CommConfig],
+                 link_class: str):
+    """One hop of the block pytree: quantized+EF when ``comm`` is active,
+    chunked when the link class asks for it. Returns (block', res')."""
+    if comm is None or not comm.active:
+        def send(x):
+            return lax_ops.rotate(
+                x, shift, axis_name,
+                num_chunks=chunks_for_link(_leaf_bytes(x), link_class))
+        return jax.tree.map(send, block), res
+
+    def send_ef(leaf, r):
+        if not _quantizable(leaf):
+            return lax_ops.rotate(leaf, shift, axis_name), r
+        flat = leaf.reshape(-1).astype(jnp.float32)
+        block_sz = quantize._block_for(flat.shape[0], comm)
+        payload, scale, n, new_r = quantize.ef_encode_flat(
+            flat, r.reshape(-1), comm, block_sz)
+        n_ax = lax_ops.num_workers(axis_name)
+        perm = [(i, (i + shift) % n_ax) for i in range(n_ax)]
+        payload = jax.lax.ppermute(payload, axis_name, perm)
+        if scale is not None:
+            scale = jax.lax.ppermute(scale, axis_name, perm)
+        out = quantize.decode_flat(payload, scale, n, comm).reshape(
+            leaf.shape).astype(leaf.dtype)
+        return out, new_r.reshape(r.shape)
+
+    # flatten/unflatten instead of a tuple-leafed tree.map: block pytrees may
+    # themselves contain tuples (kernel SVM rotates an (x, coef) pair)
+    leaves_b, treedef = jax.tree.flatten(block)
+    leaves_r = jax.tree.flatten(res)[0]
+    sent = [send_ef(lb, lr) for lb, lr in zip(leaves_b, leaves_r)]
+    new_block = jax.tree.unflatten(treedef, [s[0] for s in sent])
+    new_res = jax.tree.unflatten(treedef, [s[1] for s in sent])
+    return new_block, new_res
 
 
 def rotate_scan(
@@ -42,6 +128,8 @@ def rotate_scan(
     num_steps: int,
     axis_name: str = WORKERS,
     shift: int = 1,
+    comm: Optional[quantize.CommConfig] = None,
+    link_class: Optional[str] = None,
 ) -> Tuple[Carry, Slice]:
     """Unpipelined rotation loop: compute on the block, then shift it.
 
@@ -53,18 +141,25 @@ def rotate_scan(
     ``shift=0`` skips the permute entirely — a timing ablation that keeps the
     compute schedule but removes the collective (the block never moves, so the
     RESULT is wrong); used only to measure the rotation's share of hop time.
+
+    ``comm``/``link_class``: wire-format options (module docstring). The EF
+    residual rides in the scan carry; with ``comm`` active the returned
+    block is the lossy-wire trajectory (convergence-equivalent, not
+    bit-identical — models pin a parity tolerance vs the f32 run).
     """
+    link = _resolve_link(link_class, axis_name)
+    quant = comm is not None and comm.active
+    res0 = _ef_zero(model_block) if quant else None
 
     def step(state, t):
-        c, blk = state
+        c, blk, res = state
         c, blk = body(c, blk, t)
         if shift:
-            blk = jax.tree.map(lambda x: lax_ops.rotate(x, shift, axis_name),
-                               blk)
-        return (c, blk), None
+            blk, res = _shift_block(blk, res, shift, axis_name, comm, link)
+        return (c, blk, res), None
 
-    (carry, model_block), _ = jax.lax.scan(step, (carry, model_block),
-                                           jnp.arange(num_steps))
+    (carry, model_block, _), _ = jax.lax.scan(
+        step, (carry, model_block, res0), jnp.arange(num_steps))
     return carry, model_block
 
 
@@ -76,6 +171,8 @@ def pipelined_rotation(
     num_micro_steps: int,
     axis_name: str = WORKERS,
     shift: int = 1,
+    comm: Optional[quantize.CommConfig] = None,
+    link_class: Optional[str] = None,
 ) -> Tuple[Carry, Slice, Slice]:
     """Double-buffered rotation: compute on one slice while the other is in flight.
 
@@ -93,21 +190,34 @@ def pipelined_rotation(
 
     ``shift=0``: timing ablation, see :func:`rotate_scan` (slices still swap
     resident/inflight roles but never cross workers).
+
+    ``comm``/``link_class``: wire-format options (module docstring). One EF
+    residual per (sender, slice family): sends alternate the two slice
+    families, so the residuals ride the same resident/inflight seat swap
+    the slices do — slice A's encode error is re-sent with the next
+    A-family send, never injected into B's coordinates (and slices of
+    different shapes each keep a correctly-shaped residual).
     """
+    link = _resolve_link(link_class, axis_name)
+    quant = comm is not None and comm.active
+    res_a0 = _ef_zero(slice_a) if quant else None
+    res_b0 = _ef_zero(slice_b) if quant else None
 
     def step(state, t):
-        c, resident, inflight = state
+        c, resident, inflight, res_res, res_inf = state
         c, updated = body(c, resident, t)
         outgoing = updated
         if shift:
-            outgoing = jax.tree.map(
-                lambda x: lax_ops.rotate(x, shift, axis_name), updated)
+            outgoing, res_res = _shift_block(updated, res_res, shift,
+                                             axis_name, comm, link)
         # inflight was issued last step; it is resident for the next step. XLA sees
         # `outgoing` unused until step t+1 → overlaps the permute with t+1's compute.
-        return (c, inflight, outgoing), None
+        # The residuals swap seats in lockstep with their slices.
+        return (c, inflight, outgoing, res_inf, res_res), None
 
-    state = (carry, slice_a, slice_b)
-    (carry, sa, sb), _ = jax.lax.scan(step, state, jnp.arange(num_micro_steps))
+    state = (carry, slice_a, slice_b, res_a0, res_b0)
+    (carry, sa, sb, _, _), _ = jax.lax.scan(step, state,
+                                            jnp.arange(num_micro_steps))
     return carry, sa, sb
 
 
@@ -117,16 +227,21 @@ class Rotator:
     Harp's Rotator exposed getRotation(k)/rotate(k) imperative calls; here the
     equivalent is declarative — construct with the schedule shape, call
     :meth:`run` with the per-hop body. Kept as a class so algorithm code reads
-    like the reference's.
+    like the reference's. ``comm``/``link_class`` thread to the scan
+    implementations (module docstring).
     """
 
     def __init__(self, num_workers: int, num_slices: int = 2,
-                 axis_name: str = WORKERS):
+                 axis_name: str = WORKERS,
+                 comm: Optional[quantize.CommConfig] = None,
+                 link_class: Optional[str] = None):
         if num_slices not in (1, 2):
             raise ValueError("num_slices must be 1 (plain) or 2 (double-buffered)")
         self.num_workers = num_workers
         self.num_slices = num_slices
         self.axis_name = axis_name
+        self.comm = comm
+        self.link_class = link_class
 
     def run(self, body, carry, slices, epochs: int = 1):
         """Run ``epochs`` full rotations. ``slices``: tuple of model slices
@@ -134,9 +249,12 @@ class Rotator:
         if self.num_slices == 1:
             (slice_a,) = slices
             carry, out = rotate_scan(body, carry, slice_a,
-                                     epochs * self.num_workers, self.axis_name)
+                                     epochs * self.num_workers, self.axis_name,
+                                     comm=self.comm,
+                                     link_class=self.link_class)
             return carry, (out,)
         sa, sb = slices
         carry, sa, sb = pipelined_rotation(
-            body, carry, sa, sb, epochs * 2 * self.num_workers, self.axis_name)
+            body, carry, sa, sb, epochs * 2 * self.num_workers, self.axis_name,
+            comm=self.comm, link_class=self.link_class)
         return carry, (sa, sb)

@@ -38,13 +38,21 @@ def _perm_apply(data: jax.Array, perm) -> jax.Array:
     return jnp.take(data, jnp.asarray(perm), axis=0)
 
 
-def allreduce(t: Table, axis_name: str = WORKERS) -> Table:
+def allreduce(t: Table, axis_name: str = WORKERS, comm=None, residual=None):
     """LOCAL → REPLICATED: combine per-worker contributions partition-wise.
 
     Reference: AllreduceCollective.allreduce:150 / CollectiveMapper.allreduce:479.
+
+    ``comm``/``residual``: opt-in quantized wire format + error-feedback
+    state (collectives/quantize.py); with ``residual`` the return is
+    ``(table, residual')``, same contract as :func:`regroup`.
     """
     _expect(t, Dist.LOCAL, "allreduce")
-    out = lax_ops.allreduce(t.data, t.combiner, axis_name)
+    if residual is not None:
+        out, residual = lax_ops.allreduce(t.data, t.combiner, axis_name,
+                                          comm=comm, residual=residual)
+        return t.with_data(out, Dist.REPLICATED), residual
+    out = lax_ops.allreduce(t.data, t.combiner, axis_name, comm=comm)
     return t.with_data(out, Dist.REPLICATED)
 
 
@@ -66,16 +74,30 @@ def regroup(
     t: Table,
     partitioner: Optional[partitioner_lib.Partitioner] = None,
     axis_name: str = WORKERS,
-) -> Table:
+    comm=None,
+    residual=None,
+):
     """LOCAL → SHARDED: route each partition to its owner, combining contributions.
 
     Reference: RegroupCollective.regroupCombine:154 (partitioner → P2P dispatch →
     combine-on-arrival). Lowered to reduce_scatter (SUM/AVG) or all_to_all+combine.
+
+    ``comm``/``residual``: opt-in quantized wire format + error-feedback
+    state (collectives/quantize.py). With ``residual`` the return is
+    ``(table, residual')`` — residuals live in the PRE-permutation partition
+    order (t.data's), so the same partitioner must ride every call.
     """
     _expect(t, Dist.LOCAL, "regroup")
     perm = partitioner.permutation() if partitioner is not None else None
     data = _perm_apply(t.data, perm)
-    out = lax_ops.reduce_scatter(data, t.combiner, axis_name)
+    res = _perm_apply(residual, perm) if residual is not None else None
+    if res is not None:
+        out, res = lax_ops.reduce_scatter(data, t.combiner, axis_name,
+                                          comm=comm, residual=res)
+        inv = (partitioner.inverse_permutation() if partitioner is not None
+               else None)
+        return t.with_data(out, Dist.SHARDED), _perm_apply(res, inv)
+    out = lax_ops.reduce_scatter(data, t.combiner, axis_name, comm=comm)
     return t.with_data(out, Dist.SHARDED)
 
 
@@ -83,14 +105,16 @@ def allgather(
     t: Table,
     partitioner: Optional[partitioner_lib.Partitioner] = None,
     axis_name: str = WORKERS,
+    comm=None,
 ) -> Table:
     """SHARDED → REPLICATED (AllgatherCollective.allgather:147, ring relay).
 
     ``partitioner`` must match the one used at regroup time so partition-ID order is
-    restored after the gather.
+    restored after the gather. ``comm``: opt-in quantized wire format
+    (stateless — the gathered result stays replicated-consistent).
     """
     _expect(t, Dist.SHARDED, "allgather")
-    full = lax_ops.allgather(t.data, axis_name)
+    full = lax_ops.allgather(t.data, axis_name, comm=comm)
     inv = partitioner.inverse_permutation() if partitioner is not None else None
     full = _perm_apply(full, inv)
     return t.with_data(full, Dist.REPLICATED)
@@ -128,12 +152,23 @@ def push(
     global_table: Table,
     partitioner: Optional[partitioner_lib.Partitioner] = None,
     axis_name: str = WORKERS,
-) -> Table:
+    comm=None,
+    residual=None,
+):
     """Parameter-server push: combine LOCAL contributions into the persistent
-    SHARDED global table (LocalGlobalSyncCollective.push:209)."""
+    SHARDED global table (LocalGlobalSyncCollective.push:209).
+
+    ``comm``/``residual``: quantize the regroup's wire format; with
+    ``residual`` the return is ``(table, residual')`` (see :func:`regroup`).
+    """
     _expect(local, Dist.LOCAL, "push")
     _expect(global_table, Dist.SHARDED, "push(global)")
-    delta = regroup(local, partitioner, axis_name)
+    if residual is not None:
+        delta, residual = regroup(local, partitioner, axis_name, comm=comm,
+                                  residual=residual)
+        merged = global_table.combiner.fn(global_table.data, delta.data)
+        return global_table.with_data(merged), residual
+    delta = regroup(local, partitioner, axis_name, comm=comm)
     merged = global_table.combiner.fn(global_table.data, delta.data)
     return global_table.with_data(merged)
 
@@ -142,11 +177,12 @@ def pull(
     global_table: Table,
     partitioner: Optional[partitioner_lib.Partitioner] = None,
     axis_name: str = WORKERS,
+    comm=None,
 ) -> Table:
     """Parameter-server pull: SHARDED global → REPLICATED local copy
     (LocalGlobalSyncCollective.pull:185; the chain-bcast variant :228-295 is an XLA
-    scheduling detail here)."""
-    return allgather(global_table, partitioner, axis_name)
+    scheduling detail here). ``comm``: quantized wire format for the gather."""
+    return allgather(global_table, partitioner, axis_name, comm=comm)
 
 
 def gather(t: Table, root: int = 0, axis_name: str = WORKERS) -> Table:

@@ -39,7 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from harp_tpu import combiner as cb
-from harp_tpu.collectives import lax_ops, rotation, table_ops
+from harp_tpu.collectives import lax_ops, quantize, rotation, table_ops
 from harp_tpu.ops import distance, lane_pack, pallas_kernels
 from harp_tpu.session import HarpSession
 from harp_tpu.table import Table
@@ -72,6 +72,15 @@ class KMeansConfig:
     #   compute_dtype="bfloat16"'s documented flips. Cross-VARIANT bit
     #   identity is unaffected (every variant shares the padded formulation).
     #   Off: the pre-r6 worker-multiple-only padding.
+    quant: Optional[str] = None   # None | "int8" | "bf16": quantize the
+    #   stats-table collectives' WIRE format (collectives/quantize.py) with
+    #   error-feedback residual carried in the fit scan. The math stays f32
+    #   (dequantize-after-transport); trajectories are convergence-
+    #   equivalent, NOT bit-identical — quant breaks the cross-variant
+    #   bit-identity claim (each variant's wire format differs), and the
+    #   tests pin a per-codec tolerance vs the f32 run instead. Unsupported
+    #   for bcastreduce (rooted reduce/broadcast are masked psums whose
+    #   mask trick defeats per-block scales).
 
 
 class KMeans:
@@ -80,6 +89,11 @@ class KMeans:
     def __init__(self, session: HarpSession, config: KMeansConfig):
         if config.comm not in COMM_VARIANTS:
             raise ValueError(f"comm must be one of {COMM_VARIANTS}")
+        if config.quant is not None and config.comm == "bcastreduce":
+            raise ValueError(
+                "quant is not supported for comm='bcastreduce' (rooted "
+                "reduce/broadcast lower to masked psums; the mask defeats "
+                "per-block quantization scales) — use any other variant")
         self.session = session
         self.config = config
         self._fit = self._build()
@@ -117,37 +131,56 @@ class KMeans:
         def average(stats):
             return stats[:, :-1] / jnp.maximum(stats[:, -1:], 1.0)
 
-        def iter_body(centroids, points, x_sq_sum=None):
+        comm = (quantize.CommConfig(quant=cfg.quant) if cfg.quant is not None
+                else None)
+
+        def iter_body(centroids, points, x_sq_sum=None, qres=None):
             # centroids: (k_pad, d_pad) — phantom rows ride the collectives
-            # (zero counts → average 0) and are trimmed once, at fit_fn exit
+            # (zero counts → average 0) and are trimmed once, at fit_fn exit.
+            # qres: error-feedback residual for the quantized wire format,
+            # shaped like the stats table (quant only — the f32 programs are
+            # structurally untouched, the collective-budget manifest pins
+            # them)
             if cfg.comm == "rotation":
-                new_c, sq = self._rotation_iter(points, centroids, k_pad, w,
-                                                x_sq_sum, cdtype)
+                new_c, sq, qres = self._rotation_iter(
+                    points, centroids, k_pad, w, x_sq_sum, cdtype, comm, qres)
                 cost = jax.lax.psum(sq, lax_ops.WORKERS)
-                return new_c, cost
+                return new_c, cost, qres
             stats, sq = estep(points, centroids, x_sq_sum)
             local = Table.local(stats, num_workers=w, name="cen")
             if cfg.comm == "regroupallgather":
                 # KMeansCollectiveMapper :168-189: regroup → average own block → allgather
-                g = table_ops.regroup(local)
+                if comm is None:
+                    g = table_ops.regroup(local)
+                else:
+                    g, qres = table_ops.regroup(local, comm=comm,
+                                                residual=qres)
                 own = average(g.data)
-                new_c = lax_ops.allgather(own)
+                new_c = lax_ops.allgather(own, comm=comm)
             elif cfg.comm == "allreduce":
-                full = table_ops.allreduce(local)
+                if comm is None:
+                    full = table_ops.allreduce(local)
+                else:
+                    full, qres = table_ops.allreduce(local, comm=comm,
+                                                     residual=qres)
                 new_c = average(full.data)
             elif cfg.comm == "pushpull":
                 zero = Table.sharded(
                     jnp.zeros((k_pad // w,) + stats.shape[1:]), num_workers=w)
-                g = table_ops.push(local, zero)
-                pulled = table_ops.pull(g)
+                if comm is None:
+                    g = table_ops.push(local, zero)
+                else:
+                    g, qres = table_ops.push(local, zero, comm=comm,
+                                             residual=qres)
+                pulled = table_ops.pull(g, comm=comm)
                 new_c = average(pulled.data)
-            else:  # bcastreduce
+            else:  # bcastreduce (quant rejected at __init__)
                 red = table_ops.reduce(local, root=0)
                 own = average(red.data)
                 new_c = table_ops.broadcast(
                     Table.local(own, num_workers=w), root=0).data
             cost = jax.lax.psum(sq, lax_ops.WORKERS)
-            return new_c, cost
+            return new_c, cost, qres
 
         def fit_fn(points, centroids0):
             # points arrive feature-padded from prepare(); pad again here so
@@ -162,16 +195,31 @@ class KMeans:
             pf = points.astype(jnp.float32)
             x_sq_sum = jnp.sum(pf * pf)
 
-            def scan_body(c, _):
-                return iter_body(c, points, x_sq_sum)
+            if comm is None:
+                def scan_body(c, _):
+                    new_c, cost, _ = iter_body(c, points, x_sq_sum)
+                    return new_c, cost
 
-            cen, costs = jax.lax.scan(scan_body, cen, None, length=cfg.iterations)
+                cen, costs = jax.lax.scan(scan_body, cen, None,
+                                          length=cfg.iterations)
+            else:
+                # EF residual rides the fit carry: stats-table shaped f32
+                qres0 = jnp.zeros((k_pad, d_pad + 1), jnp.float32)
+
+                def scan_body_q(carry, _):
+                    c, qres = carry
+                    new_c, cost, qres = iter_body(c, points, x_sq_sum, qres)
+                    return (new_c, qres), cost
+
+                (cen, _), costs = jax.lax.scan(
+                    scan_body_q, (cen, qres0), None, length=cfg.iterations)
             return cen[: cfg.num_centroids, : cfg.dim], costs
 
         return sess.spmd(fit_fn, in_specs=(sess.shard(), sess.replicate()),
                          out_specs=(sess.replicate(), sess.replicate()))
 
-    def _rotation_iter(self, points, cen_pad, k_pad, w, x_sq_sum, cdtype):
+    def _rotation_iter(self, points, cen_pad, k_pad, w, x_sq_sum, cdtype,
+                       comm=None, qres=None):
         """ml/java kmeans/rotation: centroid blocks circulate the ring; each worker
         scores its points against the resident block, tracking the block-local best;
         after a full cycle the global argmin resolves and stats are aggregated.
@@ -212,12 +260,21 @@ class KMeans:
         # cannot represent integer sums past 256
         counts = jnp.sum(onehot.astype(jnp.float32), axis=0)
         stats = jnp.concatenate([sums, counts[:, None]], axis=1)
-        full = table_ops.allreduce(Table.local(stats, num_workers=w))
+        if comm is None:
+            full = table_ops.allreduce(Table.local(stats, num_workers=w))
+        else:
+            # quantized stats allreduce; the circulating centroid blocks stay
+            # f32 (they feed every argmin — a lossy block would perturb
+            # assignments each hop, where the stats error is one EF'd
+            # correction per iteration)
+            full, qres = table_ops.allreduce(
+                Table.local(stats, num_workers=w), comm=comm, residual=qres)
+        data = full.data
         # keep the full padded table in the carry (phantom rows average to
         # zero); fit_fn trims once at exit
-        new_c = full.data[:, :-1] / jnp.maximum(full.data[:, -1:], 1.0)
+        new_c = data[:, :-1] / jnp.maximum(data[:, -1:], 1.0)
         # best_d holds scores; true sq-distance cost adds the Σ‖x‖² constant
-        return new_c, jnp.sum(best_d) + x_sq_sum
+        return new_c, jnp.sum(best_d) + x_sq_sum, qres
 
     def fit(self, points: np.ndarray, centroids0: np.ndarray
             ) -> Tuple[jax.Array, jax.Array]:

@@ -62,7 +62,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from harp_tpu.collectives import lax_ops, rotation
+from harp_tpu.collectives import lax_ops, quantize, rotation
 from harp_tpu.ops import lane_pack
 from harp_tpu.parallel.mesh import WORKERS, fetch
 from harp_tpu.session import HarpSession
@@ -134,6 +134,15 @@ class LDAConfig:
     #   point; refreshing counts between doc-groups restores near-sequential
     #   mixing (the analog of the reference's per-thread token batches under
     #   the dymoro timer, Scheduler.java:110-121)
+    quant: Optional[str] = None  # None | "int8" | "bf16": quantize the
+    #   per-hop topic-total allreduce's WIRE format with error feedback
+    #   carried through the rotation + epoch scans (collectives/quantize.py).
+    #   The per-hop (K,) delta psum is LDA's allreduce hot hop (W·epochs
+    #   calls per fit); sampling probabilities then run on slightly-perturbed
+    #   totals — convergence-equivalent, not bit-identical (the parity test
+    #   uses the deterministic CVB0 method so the comparison is pure
+    #   quantization error, not CGS chain divergence). The circulating
+    #   word-topic block stays exact: its counts ARE the model.
 
 
 def bucketize_tokens(docs: np.ndarray, num_blocks: int, vpb: int,
@@ -250,6 +259,8 @@ class LDA:
         nb = w * ns                           # rotating vocab blocks in total
         vpb = v_pad // nb                     # vocab per block
         shift = 0 if cfg.ablate_rotation else 1
+        comm = (quantize.CommConfig(quant=cfg.quant)
+                if cfg.quant is not None else None)
         nmb = self._effective_minibatches(d_local)
         dg = d_local // nmb
         if cfg.wt_access not in ("auto", "gemm_scatter", "gemm", "gather"):
@@ -412,7 +423,10 @@ class LDA:
 
             def sample_resident(carry, wt_block, src):
                 """Sample every token whose home block ``src`` is resident."""
-                doc_topic, z, topic_tot, key = carry
+                if comm is None:
+                    doc_topic, z, topic_tot, key = carry
+                else:
+                    doc_topic, z, topic_tot, key, qres = carry
                 w_local = jnp.take(docs_b, src, axis=1)       # (D, Lb) slots
                 mask_s = jnp.take(mask_b, src, axis=1)
                 z_s = jnp.take(z, src, axis=1)
@@ -441,9 +455,16 @@ class LDA:
                     z = jnp.where((jnp.arange(nb) == src)[None, :, None],
                                   zs_new[:, None, :], z)
                 # bounded-staleness topic totals: refresh by psum once per hop
-                topic_tot = topic_tot + jax.lax.psum(hop_delta,
-                                                     lax_ops.WORKERS)
-                return (doc_topic, z, topic_tot, key), wt_block
+                if comm is None:
+                    topic_tot = topic_tot + jax.lax.psum(hop_delta,
+                                                         lax_ops.WORKERS)
+                    return (doc_topic, z, topic_tot, key), wt_block
+                # quantized wire format for the hop allreduce; EF residual
+                # rides the rotation (and epoch) carry
+                delta_sum, qres = lax_ops.allreduce(hop_delta, comm=comm,
+                                                    residual=qres)
+                topic_tot = topic_tot + delta_sum
+                return (doc_topic, z, topic_tot, key, qres), wt_block
 
             def hop_body(carry, wt_block, t):
                 # single-slice schedule: at hop t the resident block's home
@@ -487,25 +508,37 @@ class LDA:
                         + k * lgamma(v_beta))
 
             def epoch(state, _):
-                doc_topic, z, topic_tot, wt, key = state
+                if comm is None:
+                    doc_topic, z, topic_tot, wt, key = state
+                    hop_carry = (doc_topic, z, topic_tot, key)
+                else:
+                    doc_topic, z, topic_tot, wt, key, qres = state
+                    hop_carry = (doc_topic, z, topic_tot, key, qres)
                 if ns == 1:
-                    (doc_topic, z, topic_tot, key), wt = rotation.rotate_scan(
-                        hop_body, (doc_topic, z, topic_tot, key), wt, w,
-                        shift=shift)
+                    hop_carry, wt = rotation.rotate_scan(
+                        hop_body, hop_carry, wt, w, shift=shift)
                 else:
                     # local (2*vpb, K) block = [a-half; b-half]; 2w micro-steps
                     # bring both halves home again
-                    (doc_topic, z, topic_tot, key), sa, sb = (
-                        rotation.pipelined_rotation(
-                            micro_body, (doc_topic, z, topic_tot, key),
-                            wt[:vpb], wt[vpb:], 2 * w, shift=shift))
+                    hop_carry, sa, sb = rotation.pipelined_rotation(
+                        micro_body, hop_carry, wt[:vpb], wt[vpb:], 2 * w,
+                        shift=shift)
                     wt = jnp.concatenate([sa, sb], axis=0)
+                if comm is None:
+                    doc_topic, z, topic_tot, key = hop_carry
+                    out = (doc_topic, z, topic_tot, wt, key)
+                else:
+                    doc_topic, z, topic_tot, key, qres = hop_carry
+                    out = (doc_topic, z, topic_tot, wt, key, qres)
                 ll = ref_ll(wt, topic_tot)
-                return (doc_topic, z, topic_tot, wt, key), ll
+                return out, ll
 
-            (doc_topic, z, topic_tot, wt, key), ll = jax.lax.scan(
-                epoch, (doc_topic, z0, topic_tot, wt_block0, key), None,
-                length=cfg.epochs)
+            state0 = ((doc_topic, z0, topic_tot, wt_block0, key)
+                      if comm is None else
+                      (doc_topic, z0, topic_tot, wt_block0, key,
+                       jnp.zeros((k,), jnp.float32)))
+            state, ll = jax.lax.scan(epoch, state0, None, length=cfg.epochs)
+            doc_topic, z, _, wt = state[:4]
             return doc_topic, wt, z, ll
 
         sess = self.session

@@ -68,7 +68,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from harp_tpu.collectives import lax_ops, rotation
+from harp_tpu.collectives import lax_ops, quantize, rotation
 from harp_tpu.ops import lane_pack, pallas_kernels
 from harp_tpu.parallel.mesh import fetch
 from harp_tpu.session import HarpSession
@@ -87,6 +87,14 @@ class SGDMFConfig:
     num_slices: int = 1        # 2 = double-buffered pipeline (reference:
     #                            numModelSlices=2, dymoro comm/compute overlap)
     layout: str = "auto"       # auto | dense | sparse
+    quant: Optional[str] = None  # None | "int8" | "bf16": quantize the H-block
+    #                              rotation hops' WIRE format with error
+    #                              feedback carried in the rotation scan
+    #                              (collectives/quantize.py). Dequantize-
+    #                              after-transport: updates run f32; the
+    #                              trajectory is convergence-equivalent to
+    #                              f32, not bit-identical (tests pin a
+    #                              per-codec RMSE tolerance).
     dense_max_bytes: int = 6_000_000_000  # per-worker slab budget for auto-dense
     balance: bool = True       # serpentine-LPT id balancing for the sparse layout
 
@@ -265,7 +273,10 @@ class SGDMF:
                     w_local, h_block, sse, cnt, bucket_id)
                 return (w_local, sse, cnt), h_block
 
-            rotator = rotation.Rotator(w, cfg.num_slices)
+            rotator = rotation.Rotator(
+                w, cfg.num_slices,
+                comm=(quantize.CommConfig(quant=cfg.quant)
+                      if cfg.quant is not None else None))
 
             def epoch(state, _):
                 w_local, h = state

@@ -27,6 +27,31 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 WORKERS = "workers"  # Harp worker axis: partitions distribute over this.
 MODEL = "model"      # optional second axis for model-parallel layouts.
 
+# Link classes a mesh axis can be hinted with: ICI (on-pod interconnect,
+# one monolithic ppermute per hop is right) vs DCN (cross-pod data-center
+# network — slower, higher-latency; rotation hops chunk their payload so
+# in-flight pieces pipeline, collectives.rotation.chunks_for_link).
+LINK_CLASSES = ("ici", "dcn")
+_AXIS_LINK_CLASS: dict = {}
+
+
+def set_axis_link_class(axis_name: str, link_class: str) -> None:
+    """Hint which physical link class a mesh axis crosses (default "ici").
+
+    Gang launchers that place the ``workers`` axis across hosts/pods call
+    ``set_axis_link_class(WORKERS, "dcn")`` once at bootstrap; the rotation
+    pipeline and the collective benchmarks consult the hint for chunk
+    sizing. Process-global (the mesh topology is, too)."""
+    if link_class not in LINK_CLASSES:
+        raise ValueError(
+            f"link_class must be one of {LINK_CLASSES}, got {link_class!r}")
+    _AXIS_LINK_CLASS[axis_name] = link_class
+
+
+def axis_link_class(axis_name: str) -> str:
+    """The hinted link class for a mesh axis ("ici" when never hinted)."""
+    return _AXIS_LINK_CLASS.get(axis_name, "ici")
+
 
 def force_host_devices(n: int) -> None:
     """Request ``n`` virtual CPU devices. Must run before JAX backends initialize.

@@ -434,3 +434,33 @@ class TestGroupByKeySharded:
         np.add.at(ref, keys[good], vals[good])
         np.testing.assert_allclose(np.asarray(out).reshape(-1), ref,
                                    rtol=1e-6)
+
+
+class TestQuantizedBenchRows:
+    """The collectives_quantized bench group (bench.py --only
+    collectives_quantized): row schema + wire-byte pricing."""
+
+    def test_quant_bytes_moved_prices_the_codec_wire_format(self):
+        from harp_tpu.benchmark import collectives as bc
+
+        s = 1 << 20
+        f32_ar = bc._bytes_moved("allreduce", s, 8)
+        bf16_ar = bc._quant_bytes_moved("allreduce", s, 8, "bf16")
+        int8_ar = bc._quant_bytes_moved("allreduce", s, 8, "int8")
+        assert bf16_ar == f32_ar / 2
+        # int8 = 1/4 payload + per-256-elem f32 scales (~1.6% overhead)
+        assert f32_ar / 4 < int8_ar < f32_ar / 4 * 1.05
+        assert bc._quant_bytes_moved("rotate", s, 8, "bf16") == s / 2
+
+    def test_bench_rows_emit_convention_and_all_codecs(self, session):
+        from harp_tpu.benchmark import collectives as bc
+
+        rows = bc.bench_collectives_quantized(session, sizes_kb=[4],
+                                              loops=2)
+        assert {r["codec"] for r in rows} == {"f32", "int8", "bf16"}
+        assert {r["op"] for r in rows} == {"allreduce", "rotate"}
+        for r in rows:
+            assert r["payload_bytes_per_worker"] > 0
+            assert r["busbw_gbps"] > 0
+            assert r["link_class"] == "ici"
+            assert "busbw" in r["convention"]

@@ -275,13 +275,22 @@ def test_traced_budgets_match_committed_manifest(session):
     # the manifest's collective KINDS are the comm contract: the flagship
     # regroupallgather variant must stay reduce_scatter+all_gather (+ the
     # cost psum), not degrade to, e.g., a pair of psums
-    counts, dtype_bad = traced["kmeans_regroupallgather"]
+    counts, dtype_bad, nbytes = traced["kmeans_regroupallgather"]
     assert counts == {"psum": 1, "reduce_scatter": 1, "all_gather": 1}
     assert dtype_bad == []
+    # the byte contract: every target carries per-kind operand bytes, and
+    # the quantized twins sit well below their f32 programs — a quantized
+    # path silently reverting to f32 moves these and fails JL203
+    f32_bytes = sum(traced["kmeans_allreduce"][2].values())
+    int8_bytes = sum(traced["kmeans_allreduce_int8"][2].values())
+    assert 0 < int8_bytes < f32_bytes / 2, (int8_bytes, f32_bytes)
+    assert sum(traced["sgd_mf_dense_int8"][2].values()) < sum(
+        traced["sgd_mf_dense"][2].values())
+    assert sum(nbytes.values()) > 0
 
 
 def test_budget_drift_and_stale_rows_are_loud():
-    traced = {"kmeans_regroupallgather": ({"psum": 5}, [])}
+    traced = {"kmeans_regroupallgather": ({"psum": 5}, [], {"psum": 20})}
     findings = checkers_jaxpr.check_budget(REPO, traced)
     msgs = "\n".join(f.message for f in findings)
     # count drift on the one traced target...
@@ -290,6 +299,31 @@ def test_budget_drift_and_stale_rows_are_loud():
     assert "traced 5 vs pinned 1" in msgs
     # ...and every other committed row reports as stale/unmatched
     assert any("matches no trace target" in f.message for f in findings)
+
+
+def test_byte_budget_drift_is_loud_at_same_counts():
+    # JL203's reason to exist: SAME collective counts, different operand
+    # bytes (the silently-dropped-quantization signature) must fail even
+    # though JL201 sees no drift
+    import json
+
+    with open(os.path.join(REPO, checkers_jaxpr.BUDGET_FILE)) as f:
+        manifest = json.load(f)
+    row = manifest["targets"]["kmeans_allreduce"]
+    counts = dict(row["collectives"])
+    widened = {k: 4 * v for k, v in row["bytes_by_kind"].items()}
+    traced = {"kmeans_allreduce": (counts, [], widened)}
+    findings = checkers_jaxpr.check_budget(REPO, traced)
+    assert not any(f.code == "JL201" and f.func == "kmeans_allreduce"
+                   for f in findings)
+    hits = [f for f in findings
+            if f.code == "JL203" and f.func == "kmeans_allreduce"]
+    assert hits and "byte-budget drift" in hits[0].message
+    # a manifest row lacking bytes_per_step is itself a finding
+    clean = {"kmeans_allreduce": (counts, [],
+                                  dict(row["bytes_by_kind"]))}
+    assert not any(f.func == "kmeans_allreduce"
+                   for f in checkers_jaxpr.check_budget(REPO, clean))
 
 
 def test_dtype_policy_reports_bf16_accumulation():
@@ -302,7 +336,7 @@ def test_dtype_policy_reports_bf16_accumulation():
     x = jnp.zeros((4, 4), jnp.bfloat16)
     closed = jax.make_jaxpr(bad)(x, x)
     counts, dtype_bad = {}, []
-    checkers_jaxpr._walk(closed.jaxpr, counts, dtype_bad)
+    checkers_jaxpr._walk(closed.jaxpr, counts, dtype_bad, {})
     assert any("bf16" in m for m in dtype_bad)
 
     def good(a, b):
@@ -310,5 +344,6 @@ def test_dtype_policy_reports_bf16_accumulation():
                                    preferred_element_type=jnp.float32)
 
     counts, dtype_bad = {}, []
-    checkers_jaxpr._walk(jax.make_jaxpr(good)(x, x).jaxpr, counts, dtype_bad)
+    checkers_jaxpr._walk(jax.make_jaxpr(good)(x, x).jaxpr, counts, dtype_bad,
+                         {})
     assert dtype_bad == []

@@ -44,9 +44,12 @@ class Claim(NamedTuple):
     claim_id: str
     doc: str                    # repo-relative doc path
     pattern: str                # regex; group(1) = the claimed number
-    source: Union[tuple, Callable]   # key path into the bench record, or
-    #   a callable(bench) -> float for derived quantities (e.g. Xeon lbs)
+    source: Union[tuple, Callable]   # key path into the record, or a
+    #   callable(record) -> float for derived quantities (e.g. Xeon lbs)
     rel_tol: float = 0.10
+    file: str = BENCH_FILE      # which committed record backs the claim:
+    #   BENCH_local.json (measured rates) or tools/collective_budget.json
+    #   (traced per-step comm volumes — exact, so those claims use tol 0)
 
 
 def _xeon_lb(rate_key: str, anchor_key: str):
@@ -110,6 +113,34 @@ CLAIMS: List[Claim] = [
     Claim("min_xeon_lb_lda", "PERF.md",
           r"workloads: ALS \S+×, LDA (\S+)×",
           _xeon_lb("lda", "lda_cpu_anchor_tokens_per_sec")),
+    # PERF.md r8 comm-volume stage math: per-step collective operand bytes
+    # at tier-1 shapes, pinned to the traced manifest (jaxlint JL203 keeps
+    # the manifest honest; this table keeps the PROSE honest). Traced bytes
+    # are exact — zero tolerance.
+    Claim("comm_kmeans_allreduce_f32", "PERF.md",
+          r"K-means allreduce \(W=8 tier-1\) \| (\S+) B",
+          ("targets", "kmeans_allreduce", "bytes_per_step"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("comm_kmeans_allreduce_int8", "PERF.md",
+          r"K-means allreduce \(W=8 tier-1\) \| \S+ B \| (\S+) B",
+          ("targets", "kmeans_allreduce_int8", "bytes_per_step"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("comm_kmeans_rga_f32", "PERF.md",
+          r"K-means regroupallgather \| (\S+) B",
+          ("targets", "kmeans_regroupallgather", "bytes_per_step"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("comm_kmeans_rga_bf16", "PERF.md",
+          r"K-means regroupallgather \| \S+ B \| (\S+) B",
+          ("targets", "kmeans_regroupallgather_bf16", "bytes_per_step"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("comm_sgd_mf_f32", "PERF.md",
+          r"SGD-MF rotation hop \| (\S+) B",
+          ("targets", "sgd_mf_dense", "bytes_per_step"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("comm_sgd_mf_int8", "PERF.md",
+          r"SGD-MF rotation hop \| \S+ B \| (\S+) B",
+          ("targets", "sgd_mf_dense_int8", "bytes_per_step"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
 ]
 
 
@@ -161,15 +192,17 @@ def check_claim(claim: Claim, doc_text: str, bench: dict) -> Optional[str]:
 
 
 def check(repo: str, claims: Optional[List[Claim]] = None) -> List[str]:
-    with open(os.path.join(repo, BENCH_FILE)) as f:
-        bench = json.load(f)
+    records = {}
     docs = {}
     violations = []
     for claim in claims if claims is not None else CLAIMS:
+        if claim.file not in records:
+            with open(os.path.join(repo, claim.file)) as f:
+                records[claim.file] = json.load(f)
         if claim.doc not in docs:
             with open(os.path.join(repo, claim.doc)) as f:
                 docs[claim.doc] = f.read()
-        v = check_claim(claim, docs[claim.doc], bench)
+        v = check_claim(claim, docs[claim.doc], records[claim.file])
         if v:
             violations.append(v)
     return violations

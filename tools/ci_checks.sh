@@ -4,8 +4,10 @@
 #   1. jaxlint      — AST + jaxpr static analysis (collective divergence,
 #                     axis names, retrace hazards, host syncs, broad
 #                     excepts, scatters, collective-budget pinning, dtype
-#                     policy); nonzero on any finding or stale allowlist
-#                     entry.
+#                     policy, and JL203 byte budgets: per-step collective
+#                     operand BYTES incl. the quantized trace targets — a
+#                     quantized path silently reverting to f32 fails here);
+#                     nonzero on any finding or stale allowlist entry.
 #   2. check_claims — README/PERF headline numbers vs BENCH_local.json.
 #   3. tier-1       — the ROADMAP.md verify suite (which itself re-runs
 #                     jaxlint's clean-repo + budget checks as tests, so

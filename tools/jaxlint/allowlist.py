@@ -46,12 +46,6 @@ ALLOWLIST: Allowlist = {
         "session.run IS the documented one-shot entry point (compile and "
         "invoke once, for scripts and prepare-time programs); callers that "
         "need the trace cache hold the callable from session.spmd instead",
-    ("harp_tpu/benchmark/collectives.py", "bench_collectives", "JL103"):
-        "one spmd program per (op, payload-size) grid point by "
-        "construction — each loop iteration IS a new shape; compile and "
-        "warm-up happen before the timed region and the wrapper serves all "
-        "timed reps of that point",
-
     # -- JL104 host-sync-hot-loop: syncs that ARE the semantics ------------
     ("harp_tpu/models/kmeans.py", "fit_checkpointed", "JL104"):
         "chunk-boundary checkpoint write: the D2H snapshot of the "

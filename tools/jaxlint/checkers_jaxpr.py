@@ -15,6 +15,16 @@ Codes:
                             (ops/lane_pack's exactness contract) is bf16
                             operands with f32 accumulation
                             (preferred_element_type), never bf16 sums.
+  JL203 byte-budget         traced collective OPERAND BYTES per step drifted
+                            from the manifest's ``bytes_per_step`` /
+                            ``bytes_by_kind``. Counts alone miss comm-VOLUME
+                            regressions: the same one ppermute per hop can
+                            silently grow 4x when a quantized path falls
+                            back to f32 (the dtype changes, the count does
+                            not) or when an operand shape balloons. Bytes
+                            are summed over the collective equations'
+                            operand avals at tier-1 shapes — per STEP, same
+                            scan-body-counts-once convention as JL201.
 
 Everything here uses ``jax.make_jaxpr`` only: programs are traced, never
 executed, so the whole budget check runs in tier-1 on the virtual CPU mesh.
@@ -49,11 +59,29 @@ def _subjaxprs(eqn):
                 yield item.jaxpr
 
 
-def _walk(jaxpr, counts: Dict[str, int], dtype_bad: List[str]) -> None:
+def _aval_bytes(var) -> int:
+    aval = getattr(var, "aval", None)
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n * dtype.itemsize
+
+
+def _walk(jaxpr, counts: Dict[str, int], dtype_bad: List[str],
+          nbytes: Dict[str, int]) -> None:
     for eqn in jaxpr.eqns:
         name = eqn.primitive.name
         if name in COLLECTIVE_PRIMS:
             counts[name] = counts.get(name, 0) + 1
+            # operand bytes = what the collective puts on the wire at tier-1
+            # shapes (per-worker, inside shard_map). Summed over invars so a
+            # multi-operand psum charges every payload.
+            nbytes[name] = nbytes.get(name, 0) + sum(
+                _aval_bytes(v) for v in eqn.invars)
         # dtype policy: no f64/c128 anywhere; bf16 dots must accumulate f32
         for var in list(eqn.invars) + list(eqn.outvars):
             aval = getattr(var, "aval", None)
@@ -72,15 +100,17 @@ def _walk(jaxpr, counts: Dict[str, int], dtype_bad: List[str]) -> None:
                     "preferred_element_type=jnp.float32 (lane_pack "
                     "exactness contract: bf16 operands, f32 sums)")
         for sub in _subjaxprs(eqn):
-            _walk(sub, counts, dtype_bad)
+            _walk(sub, counts, dtype_bad, nbytes)
 
 
-def trace_target(name: str) -> Tuple[Dict[str, int], List[str]]:
-    """Trace one registry target; returns (collective counts, dtype issues).
+def trace_target(name: str) -> Tuple[Dict[str, int], List[str],
+                                     Dict[str, int]]:
+    """Trace one registry target; returns (collective counts, dtype issues,
+    collective operand bytes by kind).
 
-    Counts are STATIC occurrences in the traced program. The hot loop of
-    every target is a ``lax.scan`` over iterations, so a collective in the
-    scan body counts once — i.e. the manifest records collectives **per
+    Counts/bytes are STATIC occurrences in the traced program. The hot loop
+    of every target is a ``lax.scan`` over iterations, so a collective in
+    the scan body counts once — i.e. the manifest records collectives **per
     step**, not per run (iteration counts are config, not contract).
     """
     import jax
@@ -91,11 +121,13 @@ def trace_target(name: str) -> Tuple[Dict[str, int], List[str]]:
     closed = jax.make_jaxpr(fn)(*args)
     counts: Dict[str, int] = {}
     dtype_bad: List[str] = []
-    _walk(closed.jaxpr, counts, dtype_bad)
-    return counts, dtype_bad
+    nbytes: Dict[str, int] = {}
+    _walk(closed.jaxpr, counts, dtype_bad, nbytes)
+    return counts, dtype_bad, nbytes
 
 
-def trace_all() -> Dict[str, Tuple[Dict[str, int], List[str]]]:
+def trace_all() -> Dict[str, Tuple[Dict[str, int], List[str],
+                                   Dict[str, int]]]:
     from tools.jaxlint import trace_targets
 
     trace_targets.ensure_cpu_mesh()
@@ -111,23 +143,30 @@ def load_budget(repo_root: str) -> Optional[dict]:
         return json.load(f)
 
 
-def write_budget(repo_root: str,
-                 traced: Dict[str, Tuple[Dict[str, int], List[str]]]) -> str:
+def write_budget(repo_root: str, traced) -> str:
     import jax
 
     path = os.path.join(repo_root, BUDGET_FILE)
     doc = {
         "_contract": (
             "Collectives-per-step manifest: static collective-primitive "
-            "counts in each model's traced step program at tier-1 shapes "
-            "(tools/jaxlint/trace_targets.py). Tier-1 fails on ANY drift — "
-            "an extra psum per step is a perf regression, a changed kind "
-            "is a changed comm algorithm; regenerate deliberately with "
-            "`python -m tools.jaxlint --update-budget` and review the "
-            "diff. Counts are per STEP (scan bodies count once)."),
+            "counts AND operand bytes in each model's traced step program "
+            "at tier-1 shapes (tools/jaxlint/trace_targets.py). Tier-1 "
+            "fails on ANY drift — an extra psum per step is a perf "
+            "regression, a changed kind is a changed comm algorithm, and "
+            "changed bytes at the same counts is a comm-VOLUME regression "
+            "(e.g. a quantized path silently falling back to f32); "
+            "regenerate deliberately with `python -m tools.jaxlint "
+            "--update-budget` and review the diff. Counts/bytes are per "
+            "STEP (scan bodies count once)."),
         "traced_with_jax": jax.__version__,
-        "targets": {name: {"collectives": dict(sorted(counts.items()))}
-                    for name, (counts, _bad) in sorted(traced.items())},
+        "targets": {
+            name: {
+                "collectives": dict(sorted(counts.items())),
+                "bytes_per_step": sum(nbytes.values()),
+                "bytes_by_kind": dict(sorted(nbytes.items())),
+            }
+            for name, (counts, _bad, nbytes) in sorted(traced.items())},
     }
     with open(path, "w", encoding="utf-8") as f:
         json.dump(doc, f, indent=2, sort_keys=False)
@@ -135,11 +174,8 @@ def write_budget(repo_root: str,
     return path
 
 
-def check_budget(repo_root: str,
-                 traced: Optional[Dict[str, Tuple[Dict[str, int],
-                                                  List[str]]]] = None,
-                 ) -> List[Finding]:
-    """JL201/JL202 findings for the whole trace registry."""
+def check_budget(repo_root: str, traced=None) -> List[Finding]:
+    """JL201/JL202/JL203 findings for the whole trace registry."""
     if traced is None:
         traced = trace_all()
     findings: List[Finding] = []
@@ -158,7 +194,7 @@ def check_budget(repo_root: str,
     else:
         budget_targets = budget.get("targets", {})
 
-    for name, (counts, dtype_bad) in sorted(traced.items()):
+    for name, (counts, dtype_bad, nbytes) in sorted(traced.items()):
         for issue in dtype_bad:
             emit("JL202", "dtype-policy", name, issue)
         if budget is None:
@@ -179,6 +215,32 @@ def check_budget(repo_root: str,
                  f"collective budget drift ({'; '.join(drift)}) — if "
                  f"intentional, regenerate with --update-budget and review "
                  f"the diff; if not, a step gained/lost communication")
+        # JL203: comm volume. A manifest row predating byte budgets (no
+        # bytes_per_step key) is itself a finding — the byte contract must
+        # cover every target.
+        pinned_total = budget_targets[name].get("bytes_per_step")
+        pinned_kinds = budget_targets[name].get("bytes_by_kind", {})
+        total = sum(nbytes.values())
+        if pinned_total is None:
+            emit("JL203", "byte-budget", name,
+                 f"manifest entry {name!r} has no bytes_per_step — "
+                 f"regenerate with --update-budget so the byte contract "
+                 f"covers it")
+        elif total != pinned_total or dict(nbytes) != dict(pinned_kinds):
+            drift = []
+            for kind in sorted(set(nbytes) | set(pinned_kinds)):
+                got, want = nbytes.get(kind, 0), pinned_kinds.get(kind, 0)
+                if got != want:
+                    drift.append(f"{kind}: traced {got} B vs pinned {want} B")
+            if total != pinned_total:
+                drift.append(f"total: traced {total} B vs pinned "
+                             f"{pinned_total} B")
+            emit("JL203", "byte-budget", name,
+                 f"collective byte-budget drift ({'; '.join(drift)}) — "
+                 f"comm VOLUME changed at tier-1 shapes (same-count dtype "
+                 f"widening, e.g. a quantized path silently reverting to "
+                 f"f32, lands here); if intentional, --update-budget and "
+                 f"review the diff")
     for name in sorted(set(budget_targets) - set(traced)):
         emit("JL201", "collective-budget", name,
              f"manifest entry {name!r} matches no trace target — stale row "

@@ -65,13 +65,13 @@ def _rng():
 # -- builders: () -> (callable, args) --------------------------------------
 
 
-def _kmeans(comm: str):
+def _kmeans(comm: str, quant=None):
     def build():
         from harp_tpu.models import kmeans as km
 
         sess = _session()
         model = km.KMeans(sess, km.KMeansConfig(8, 16, iterations=2,
-                                                comm=comm))
+                                                comm=comm, quant=quant))
         rng = _rng()
         pts = rng.normal(size=(64, 16)).astype("float32")
         p, c = model.prepare(pts, pts[:8].copy())
@@ -101,22 +101,25 @@ def _lda_subblock():
     return model._fns[key], (*data, seed)
 
 
-def _sgd_mf():
-    from harp_tpu.models import sgd_mf
+def _sgd_mf(quant=None):
+    def build():
+        from harp_tpu.models import sgd_mf
 
-    sess = _session()
-    cfg = sgd_mf.SGDMFConfig(rank=8, lam=0.01, lr=0.1, epochs=2,
-                             minibatches_per_hop=2)
-    model = sgd_mf.SGDMF(sess, cfg)
-    rng = _rng()
-    n = 400
-    rows = rng.integers(0, 64, size=n)
-    cols = rng.integers(0, 48, size=n)
-    vals = rng.normal(size=n).astype("float32")
-    layout, data, w0, h0, meta = model.prepare(rows, cols, vals, 64, 48)
-    key = model._program(layout, cfg.minibatches_per_hop, cfg.epochs,
-                         meta[6])
-    return model._compiled[key], (*data, w0, h0)
+        sess = _session()
+        cfg = sgd_mf.SGDMFConfig(rank=8, lam=0.01, lr=0.1, epochs=2,
+                                 minibatches_per_hop=2, quant=quant)
+        model = sgd_mf.SGDMF(sess, cfg)
+        rng = _rng()
+        n = 400
+        rows = rng.integers(0, 64, size=n)
+        cols = rng.integers(0, 48, size=n)
+        vals = rng.normal(size=n).astype("float32")
+        layout, data, w0, h0, meta = model.prepare(rows, cols, vals, 64, 48)
+        key = model._program(layout, cfg.minibatches_per_hop, cfg.epochs,
+                             meta[6])
+        return model._compiled[key], (*data, w0, h0)
+
+    return build
 
 
 def _als():
@@ -174,15 +177,23 @@ def _nn():
 
 # Registry: target name -> builder returning (traceable callable, args).
 # Names are the manifest keys — renaming one is a manifest change.
+# The *_int8/*_bf16 rows pin the QUANTIZED step programs: their byte rows
+# sit far below the f32 twins', so a quantized path silently reverting to
+# f32 (same collective counts, 2-4x the operand bytes) fails JL203 exactly
+# like count drift fails JL201.
 TARGETS: Dict[str, Callable[[], Tuple[Callable, tuple]]] = {
     "kmeans_regroupallgather": _kmeans("regroupallgather"),
     "kmeans_allreduce": _kmeans("allreduce"),
     "kmeans_pushpull": _kmeans("pushpull"),
     "kmeans_bcastreduce": _kmeans("bcastreduce"),
     "kmeans_rotation": _kmeans("rotation"),
+    "kmeans_allreduce_int8": _kmeans("allreduce", quant="int8"),
+    "kmeans_regroupallgather_bf16": _kmeans("regroupallgather",
+                                            quant="bf16"),
     "lda_cgs": _lda,
     "lda_cgs_subblock128": _lda_subblock,
-    "sgd_mf_dense": _sgd_mf,
+    "sgd_mf_dense": _sgd_mf(),
+    "sgd_mf_dense_int8": _sgd_mf(quant="int8"),
     "als_explicit": _als,
     "pagerank": _pagerank,
     "nn_mlp": _nn,
