@@ -830,6 +830,75 @@ def tpu_collectives_quantized(small=False):
                                           loops=20)
 
 
+def tpu_telemetry_overhead(small=False):
+    """Telemetry on/off delta on the kmeans fit loop (ISSUE 7 acceptance:
+    < 2% on-chip, asserted here). Runs the fit_checkpointed dispatch shape —
+    one compiled iteration per host step with the cost fetched at each
+    boundary — with and without `telemetry.record_chunk` + the comm ledger,
+    both sides timing and fetching identically, so the delta is exactly the
+    telemetry layer. Returns None on a CPU-only host (null-with-note
+    convention; the driver's on-chip run fills it)."""
+    import statistics
+    import tempfile
+
+    import jax
+
+    if all(d.platform == "cpu" for d in jax.devices()):
+        return None
+    from harp_tpu import telemetry
+    from harp_tpu.io import datagen
+    from harp_tpu.models import kmeans as km
+    from harp_tpu.session import HarpSession
+
+    sess = HarpSession()
+    n, k, d = (100_000, 100, 100) if small else (1_000_000, 100, 100)
+    iters = 30 if small else 200
+    pts = datagen.dense_points(n, d, seed=7, num_clusters=k)
+    pts = pts[: len(pts) - len(pts) % sess.num_workers]
+    cen0 = datagen.initial_centroids(pts, k, seed=3)
+    model = km.KMeans(sess, km.KMeansConfig(k, d, 1))
+    p, c0 = model.prepare(pts, cen0)
+    step = model._fit
+
+    def run(ledger=None, record=False):
+        cen = c0
+        t0 = time.perf_counter()
+        for i in range(iters):
+            it0 = time.perf_counter()
+            cen, cost = step(p, cen)
+            loss = [float(np.asarray(cost)[0])]       # the boundary D2H
+            wall = time.perf_counter() - it0
+            if record:
+                telemetry.record_chunk("kmeans", start=i, losses=loss,
+                                       wall_s=wall, ledger=ledger)
+        return time.perf_counter() - t0
+
+    run()                                             # compile + warm
+    t_off = statistics.median(run() for _ in range(3))
+    tele_dir = tempfile.mkdtemp(prefix="harp-bench-tele-")
+    telemetry.configure(tele_dir, interval=16)
+    ledger = telemetry.ledger_for("kmeans", comm="regroupallgather",
+                                  scale=model.comm_scale(),
+                                  exact=sess.num_workers == 8)
+    try:
+        t_on = statistics.median(run(ledger, record=True)
+                                 for _ in range(3))
+    finally:
+        telemetry.disable()
+    overhead_pct = round(100.0 * (t_on - t_off) / t_off, 3)
+    # the acceptance contract rides IN the row (pass flag), and main() exits
+    # nonzero on failure AFTER committing the record — the failing number
+    # must land in BENCH_local.json, not vanish into a swallowed assert
+    return {"config": f"n={len(pts)} k={k} d={d} iters={iters} "
+                      f"dispatch=1-iter-chunks",
+            "off_iters_per_sec": round(iters / t_off, 1),
+            "on_iters_per_sec": round(iters / t_on, 1),
+            "overhead_pct": overhead_pct,
+            "contract": "overhead_pct < 2.0 (ISSUE 7 acceptance)",
+            "pass": bool(overhead_pct < 2.0),
+            "telemetry_dir": tele_dir}
+
+
 def p2p_event_rtt_us(rounds=200):
     """Host event-plane round trip (send → wait_event → reply → wait): the
     latency the true P2P transport (authenticated, loopback) delivers.
@@ -907,7 +976,7 @@ ROW_GROUPS = ("kmeans", "kmeans_padded128", "kmeans_csr", "sgd_mf", "als",
               "pca", "lda", "lda_large", "lda_clueweb_subblock", "nn",
               "nn_compute_bound", "attention", "attention_blocksparse",
               "kernel_svm", "mds", "sort", "csr_cov", "kmeans_from_files",
-              "p2p", "mesh", "collectives_quantized")
+              "p2p", "mesh", "collectives_quantized", "telemetry_overhead")
 
 
 def main():
@@ -1245,6 +1314,30 @@ def main():
                     compact[f"allreduce_{r['codec']}_busbw_gbps"] = (
                         r["busbw_gbps"])
 
+    if want("telemetry_overhead"):
+        begin("telemetry_overhead")
+        try:
+            trow = tpu_telemetry_overhead(small)
+        except Exception as e:     # noqa: BLE001 — bench must not die here
+            trow = {"error": str(e)[:200]}
+        detail["telemetry_overhead"] = trow
+        if trow is None:
+            detail["bench_schema_note_r9"] = (
+                "r9 adds the telemetry_overhead group (bench.py --only "
+                "telemetry_overhead): kmeans fit loop in 1-iteration "
+                "dispatch chunks with and without harp_tpu.telemetry "
+                "record_chunk + comm-ledger at every boundary; the row "
+                "asserts the on/off delta < 2% (ISSUE 7 acceptance) — "
+                "committed null because no TPU was reachable from this "
+                "session (CPU-only devices); the driver's on-chip bench "
+                "run fills it. The CPU-flavor contract (telemetry per-step "
+                "cost < 2% of a measured kmeans step) IS asserted in "
+                "tier-1: tests/test_telemetry.py "
+                "test_telemetry_overhead_cpu_smoke.")
+        elif isinstance(trow, dict) and "overhead_pct" in trow:
+            compact["telemetry_overhead_pct"] = trow["overhead_pct"]
+            compact["telemetry_overhead_pass"] = trow["pass"]
+
     detail["xeon_anchor_note"] = (
         f"vs_cpu = measured vs ONE modern Zen core (this host has 1 "
         f"core); vs_xeon36_lb = vs_cpu/{XEON_CORES}, a conservative "
@@ -1276,6 +1369,15 @@ def main():
     if only is not None:
         compact["only"] = ",".join(selected)
     print(json.dumps(compact))
+
+    # acceptance-gated rows fail the bench AFTER the record is committed —
+    # the number is on disk either way, and CI sees the breach
+    trow = detail.get("telemetry_overhead")
+    if isinstance(trow, dict) and trow.get("pass") is False:
+        sys.stderr.write(
+            f"bench: telemetry_overhead contract FAILED "
+            f"({trow['overhead_pct']}% >= 2%)\n")
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from harp_tpu import telemetry
 from harp_tpu.collectives import lax_ops
 from harp_tpu.parallel.mesh import WORKERS
 from harp_tpu.session import HarpSession
@@ -509,9 +510,21 @@ class ALS:
         """Run the compiled train program; factors stay ON DEVICE. Returns
         (u_dev, v_dev, rmse ndarray) — the benchmark timing surface (the
         rmse fetch forces execution; the factor D2H is a one-time cost)."""
+        import time as _time
+
         key, placed, _, _ = state
+        t0 = _time.perf_counter()
         u, v, rmse = self._fns[key](*placed)
-        return u, v, np.asarray(rmse)
+        rmse = np.asarray(rmse)
+        # telemetry at the rmse fetch that was already here (per-iteration
+        # events, wall amortized over the scanned program); the manifest row
+        # pins the explicit path only — implicit jobs get no comm row
+        telemetry.record_chunk(
+            "als", start=0, losses=rmse.tolist(),
+            wall_s=_time.perf_counter() - t0,
+            ledger=(telemetry.ledger_for("als")
+                    if not self.config.implicit else None))
+        return u, v, rmse
 
     def fit_prepared(self, state
                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:

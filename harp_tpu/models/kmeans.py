@@ -31,6 +31,7 @@ accumulations f32 but near-tie assignments may differ across variants.
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 from typing import Optional, Tuple
 
@@ -39,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from harp_tpu import combiner as cb
+from harp_tpu import telemetry
 from harp_tpu.collectives import lax_ops, quantize, rotation, table_ops
 from harp_tpu.ops import distance, lane_pack, pallas_kernels
 from harp_tpu.session import HarpSession
@@ -46,6 +48,9 @@ from harp_tpu.table import Table
 
 COMM_VARIANTS = ("regroupallgather", "allreduce", "pushpull", "bcastreduce",
                  "rotation")
+# the collective-budget manifest's trace mesh width (tools/jaxlint/
+# trace_targets.NUM_WORKERS) — comm telemetry pricing is exact only there
+TRACE_WORKERS = 8
 
 
 @dataclasses.dataclass(frozen=True)
@@ -276,6 +281,21 @@ class KMeans:
         # best_d holds scores; true sq-distance cost adds the Σ‖x‖² constant
         return new_c, jnp.sum(best_d) + x_sq_sum, qres
 
+    def comm_scale(self) -> float:
+        """Ratio of this model's padded stat-table elements to the budget
+        manifest's traced tier-1 shape (k=8, d=16, w=8, lane_pad default):
+        every K-means collective moves slices of the (k_pad, d_pad+1) f32
+        table, so the manifest's ``bytes_per_step`` times this ratio prices
+        the job's true wire volume (the few-byte scalar-cost psum rides
+        unscaled — noise). EXACT only at ``num_workers == TRACE_WORKERS``:
+        the sharded variants' operands (a 1/w table shard per
+        reduce_scatter/all_gather) also depend on w, which this ratio does
+        not capture — fit_checkpointed passes exact= accordingly. Consumed
+        by telemetry.comm_ledger."""
+        ref_k = lane_pack.lane_target(8, divisor=TRACE_WORKERS)
+        ref_d = lane_pack.round_up(16, lane_pack.LANES)
+        return (self._k_pad * (self._d_pad + 1)) / (ref_k * (ref_d + 1))
+
     def fit(self, points: np.ndarray, centroids0: np.ndarray
             ) -> Tuple[jax.Array, jax.Array]:
         """Run the full training; returns (final centroids, per-iteration cost).
@@ -355,6 +375,17 @@ class KMeans:
                 jnp.asarray(saved["centroids"]))
         chunk_fits = {}
         costs = []
+        # telemetry (harp_tpu.telemetry): step events + manifest-priced comm
+        # volume at the chunk boundaries below — the ONLY host syncs are the
+        # np.asarray(cost) fetches that were already here; None when off.
+        # Pricing is exact only at the manifest's traced worker count: the
+        # sharded variants' per-step operands (reduce_scatter/all_gather
+        # shards) depend on w, not just on the table elements comm_scale
+        # rescales (comm_ledger.ledger_for docstring)
+        ledger = telemetry.ledger_for(
+            "kmeans", comm=self.config.comm, quant=self.config.quant,
+            scale=self.comm_scale(),
+            exact=self.session.num_workers == TRACE_WORKERS)
         it = start
         while it < total:
             # iteration-boundary fault hook (parallel.faults): a scripted
@@ -365,10 +396,17 @@ class KMeans:
                 chunk_fits[chunk] = KMeans(
                     self.session,
                     dataclasses.replace(self.config, iterations=chunk))._fit
+            t0 = time.perf_counter()
             cen, cost = chunk_fits[chunk](pts, cen)
-            costs.extend(np.asarray(cost).tolist())
+            chunk_costs = np.asarray(cost).tolist()
+            wall = time.perf_counter() - t0
+            costs.extend(chunk_costs)
+            telemetry.record_chunk("kmeans", start=it, losses=chunk_costs,
+                                   wall_s=wall, ledger=ledger,
+                                   extra={"comm": self.config.comm})
             it += chunk
-            checkpointer.save(it, {"centroids": np.asarray(cen)})
+            with telemetry.phase("kmeans.checkpoint"):
+                checkpointer.save(it, {"centroids": np.asarray(cen)})
         if hasattr(checkpointer, "wait"):
             checkpointer.wait()       # surface a failed async final write
         return cen, np.asarray(costs, np.float32), start

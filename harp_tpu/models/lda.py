@@ -62,6 +62,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from harp_tpu import telemetry
 from harp_tpu.collectives import lax_ops, quantize, rotation
 from harp_tpu.ops import lane_pack
 from harp_tpu.parallel.mesh import WORKERS, fetch
@@ -660,13 +661,25 @@ class LDA:
     def fit_prepared(self, state
                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Run training on already-placed device data (no host prep)."""
+        import time as _time
+
         key, data, seed, (word_block, word_slot, vpb) = state
+        t0 = _time.perf_counter()
         doc_topic, wt_out, z, ll = self._fns[key](*data, seed)
+        ll = np.asarray(ll)
+        wall = _time.perf_counter() - t0
+        # telemetry at the ll fetch that was already here (per-epoch events,
+        # wall amortized over the scanned program)
+        telemetry.record_chunk(
+            "lda", start=0, losses=ll.tolist(), wall_s=wall,
+            ledger=telemetry.ledger_for(
+                "lda", quant=self.config.quant,
+                sub_block=bool(self.config.vocab_sub_block)))
         # un-permute word rows back to original vocab ids; fetch() gathers
         # sharded outputs across gang processes (run.py gang CLI)
         wt_out = fetch(wt_out)
         wt_final = wt_out[self._out_rows(key[0], word_block, word_slot, vpb)]
-        return fetch(doc_topic), wt_final, np.asarray(ll)
+        return fetch(doc_topic), wt_final, ll
 
     def fit(self, docs: np.ndarray, seed: int = 0
             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -720,6 +733,11 @@ class LDA:
         chunk_fns = {}
         lls = []
         doc_topic = None
+        # telemetry: step events at the chunk boundaries' existing ll fetch
+        ledger = telemetry.ledger_for(
+            "lda", quant=cfg.quant, sub_block=bool(cfg.vocab_sub_block))
+        import time as _time
+
         ep = start
         while ep < total:
             # iteration-boundary fault hook (parallel.faults)
@@ -731,13 +749,19 @@ class LDA:
                 sub = LDA(sess, dataclasses.replace(cfg, epochs=chunk))
                 chunk_fns[chunk] = sub._build(w, v_pad, lb, num_docs // w,
                                               lbs)
+            t0 = _time.perf_counter()
             doc_topic, wt_cur, z_cur, ll = chunk_fns[chunk](
                 docs_b, mask_b, z_cur, wt_cur,
                 jnp.asarray(int(seed) + ep, jnp.int32))
-            lls.extend(np.asarray(ll).tolist())
+            chunk_lls = np.asarray(ll).tolist()
+            wall = _time.perf_counter() - t0
+            lls.extend(chunk_lls)
+            telemetry.record_chunk("lda", start=ep, losses=chunk_lls,
+                                   wall_s=wall, ledger=ledger)
             ep += chunk
-            checkpointer.save(ep, {"z": fetch(z_cur),
-                                   "wt": fetch(wt_cur)})
+            with telemetry.phase("lda.checkpoint"):
+                checkpointer.save(ep, {"z": fetch(z_cur),
+                                       "wt": fetch(wt_cur)})
         if hasattr(checkpointer, "wait"):
             checkpointer.wait()       # surface a failed async final write
         wt_out = fetch(wt_cur)

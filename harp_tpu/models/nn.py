@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from harp_tpu import telemetry
 from harp_tpu.parallel.mesh import WORKERS
 from harp_tpu.session import HarpSession
 
@@ -114,11 +115,20 @@ class MLPClassifier:
                 lambda a, t, p: _train(a, t, p, cfg),
                 in_specs=(sess.shard(), sess.shard(), sess.replicate()),
                 out_specs=(sess.replicate(), sess.replicate()))
+        import time as _time
+
+        t0 = _time.perf_counter()
         params, losses = self._fn(
             sess.scatter(jnp.asarray(x, jnp.float32)),
             sess.scatter(jnp.asarray(y, jnp.int32)), params0)
         self.params = jax.tree.map(np.asarray, params)
-        return np.asarray(losses)
+        losses = np.asarray(losses)
+        # telemetry at the loss fetch that was already here (per-epoch
+        # events, wall amortized over the scanned program)
+        telemetry.record_chunk("nn", start=0, losses=losses.tolist(),
+                               wall_s=_time.perf_counter() - t0,
+                               ledger=telemetry.ledger_for("nn"))
+        return losses
 
     def predict(self, x: np.ndarray) -> np.ndarray:
         logits = forward([(jnp.asarray(w), jnp.asarray(b))

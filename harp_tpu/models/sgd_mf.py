@@ -68,6 +68,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from harp_tpu import telemetry
 from harp_tpu.collectives import lax_ops, quantize, rotation
 from harp_tpu.ops import lane_pack, pallas_kernels
 from harp_tpu.parallel.mesh import fetch
@@ -678,11 +679,21 @@ class SGDMF:
         this is the timing surface benchmarks use: steady-state epoch
         throughput, not the one-time D2H of the final model (bench.py,
         PERF.md). :meth:`fit_prepared` adds the fetch + de-permutation."""
+        import time as _time
+
         layout, data, w0, h0, meta = state
         key = self._program(layout, self.config.minibatches_per_hop,
                             self.config.epochs, meta[6])
+        t0 = _time.perf_counter()
         out_w, out_h, rmse = self._compiled[key](*data, w0, h0)
-        return out_w, out_h, np.asarray(rmse)
+        rmse = np.asarray(rmse)
+        # telemetry at the fetch that was already here: one event per epoch,
+        # wall amortized over the scanned program (step_log docstring)
+        telemetry.record_chunk(
+            "sgd_mf", start=0, losses=rmse.tolist(),
+            wall_s=_time.perf_counter() - t0,
+            ledger=telemetry.ledger_for("sgd_mf", quant=self.config.quant))
+        return out_w, out_h, rmse
 
     def fit_prepared(self, state) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Run training on already-placed device data (no host prep)."""
@@ -789,14 +800,25 @@ class SGDMF:
         key = self._program(layout, nmb, 1, geom)
         fn = self._compiled[key]
         rmses = []
+        # telemetry: per-epoch step events at the existing np.asarray(r)
+        # host sync (one epoch per host step here — real per-step timing)
+        ledger = telemetry.ledger_for("sgd_mf", quant=self.config.quant)
+        import time as _time
+
         for epoch in range(start, epochs):
             # iteration-boundary fault hook (parallel.faults)
             faults.fire(epoch + 1, checkpointer)
+            t0 = _time.perf_counter()
             w_cur, h_cur, r = fn(*data, w_cur, h_cur)
-            rmses.append(np.asarray(r)[0])
+            rmse_e = float(np.asarray(r)[0])
+            wall = _time.perf_counter() - t0
+            rmses.append(rmse_e)
+            telemetry.record_chunk("sgd_mf", start=epoch, losses=[rmse_e],
+                                   wall_s=wall, ledger=ledger)
             if (epoch + 1) % save_every == 0 or epoch + 1 == epochs:
-                checkpointer.save(epoch + 1, {"w": fetch(w_cur),
-                                              "h": fetch(h_cur)})
+                with telemetry.phase("sgd_mf.checkpoint"):
+                    checkpointer.save(epoch + 1, {"w": fetch(w_cur),
+                                                  "h": fetch(h_cur)})
         if hasattr(checkpointer, "wait"):
             checkpointer.wait()     # surface a failed async final write
         w_final, h_final = self._finalize(w_cur, h_cur, meta)

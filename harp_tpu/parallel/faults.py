@@ -16,18 +16,28 @@ kinds
     ``ckpt-corrupt`` flip bytes in the newest completed checkpoint's
                      ``arrays.npz`` — exercises the manifest-checksum
                      fallback on resume.
+    ``slow``         sleep ``ms`` milliseconds at EVERY iteration boundary
+                     from epoch N on — a sustained straggler (throttled
+                     chip, sick link), not a death. Exercises the gang
+                     telemetry straggler detector (harp_tpu.telemetry.gang),
+                     which must flag the rank while it stays alive.
 
 keys
     ``epoch=N``   (required) fire at the first iteration boundary that
                   reaches epoch N: ``crash``/``hang`` fire *before* epoch N
                   runs (so the newest checkpoint is at most N-1);
-                  ``ckpt-corrupt`` fires once epoch N's checkpoint exists.
+                  ``ckpt-corrupt`` fires once epoch N's checkpoint exists;
+                  ``slow`` fires at that boundary AND every later one
+                  (sustained — a one-boundary hiccup must not look like a
+                  straggler to the detector it exists to test).
     ``rank=R``    only this gang member fires (HARP_PROCESS_ID; a process
                   outside a gang is rank 0). Omitted = every rank.
     ``attempt=A`` only fire on supervisor attempt A (HARP_GANG_ATTEMPT,
                   0 outside the supervisor). Default 0 — the fault fires on
                   the first launch and NOT again after a relaunch, which is
                   what makes "die once, recover, finish" scriptable.
+    ``ms=M``      ``slow`` only: the per-boundary sleep, milliseconds
+                  (default 100).
 
 The hooks are checked host-side between compiled chunks (the models'
 ``fit_checkpointed`` loops), never inside XLA programs: a fault can only
@@ -43,7 +53,8 @@ import time
 from typing import List, Optional
 
 FAULT_CRASH_EXIT = 41      # distinct from the watchdog's 98: a scripted death
-_KINDS = ("crash", "hang", "ckpt-corrupt")
+_KINDS = ("crash", "hang", "ckpt-corrupt", "slow")
+SLOW_DEFAULT_MS = 100
 
 
 @dataclasses.dataclass(frozen=True)
@@ -52,6 +63,7 @@ class FaultSpec:
     epoch: int
     rank: Optional[int] = None      # None = every rank
     attempt: int = 0
+    ms: int = SLOW_DEFAULT_MS       # slow only: per-boundary sleep
 
 
 def parse_faults(text: str) -> List[FaultSpec]:
@@ -67,9 +79,9 @@ def parse_faults(text: str) -> List[FaultSpec]:
         kv = {}
         for item in filter(None, argstr.split(":")):
             key, eq, val = item.partition("=")
-            if not eq or key not in ("epoch", "rank", "attempt"):
+            if not eq or key not in ("epoch", "rank", "attempt", "ms"):
                 raise ValueError(f"fault spec {part!r}: bad argument "
-                                 f"{item!r} (epoch=/rank=/attempt=)")
+                                 f"{item!r} (epoch=/rank=/attempt=/ms=)")
             try:
                 kv[key] = int(val)
             except ValueError:
@@ -77,14 +89,19 @@ def parse_faults(text: str) -> List[FaultSpec]:
                                  f"not an integer") from None
         if "epoch" not in kv:
             raise ValueError(f"fault spec {part!r}: epoch= is required")
+        if "ms" in kv and kind != "slow":
+            raise ValueError(f"fault spec {part!r}: ms= applies to slow "
+                             f"faults only")
         specs.append(FaultSpec(kind, kv["epoch"], kv.get("rank"),
-                               kv.get("attempt", 0)))
+                               kv.get("attempt", 0),
+                               kv.get("ms", SLOW_DEFAULT_MS)))
     return specs
 
 
 _cache_key: Optional[str] = None
 _cache_specs: List[FaultSpec] = []
 _fired: set = set()
+_printed: set = set()      # slow faults announce once, then sleep silently
 
 
 def _plan() -> List[FaultSpec]:
@@ -101,6 +118,7 @@ def _plan() -> List[FaultSpec]:
         _cache_key = text
         _cache_specs = specs
         _fired.clear()
+        _printed.clear()
     return _cache_specs
 
 
@@ -126,7 +144,10 @@ def fire(next_epoch: int, checkpointer=None) -> None:
     # damage the checkpoint before the death ends the process
     order = sorted(specs, key=lambda s: s.kind != "ckpt-corrupt")
     for spec in order:
-        if spec in _fired or spec.attempt != attempt:
+        # slow is SUSTAINED: it fires at every due boundary (never enters
+        # _fired) — that is what makes it a straggler rather than a hiccup
+        if (spec in _fired and spec.kind != "slow") \
+                or spec.attempt != attempt:
             continue
         if spec.rank is not None and spec.rank != me:
             continue
@@ -139,6 +160,15 @@ def fire(next_epoch: int, checkpointer=None) -> None:
 
 
 def _execute(spec: FaultSpec, checkpointer) -> None:
+    if spec.kind == "slow":
+        # announce once, then just drag: one sleep per boundary, sustained
+        if spec not in _printed:
+            _printed.add(spec)
+            print(f"harp_tpu.faults: straggling slow@epoch={spec.epoch} "
+                  f"ms={spec.ms} (rank {_me()}, attempt {_attempt()}) — "
+                  f"every boundary from here", file=sys.stderr, flush=True)
+        time.sleep(spec.ms / 1000.0)
+        return
     print(f"harp_tpu.faults: firing {spec.kind}@epoch={spec.epoch} "
           f"(rank {_me()}, attempt {_attempt()})", file=sys.stderr, flush=True)
     if spec.kind == "crash":

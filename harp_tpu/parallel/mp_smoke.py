@@ -218,6 +218,65 @@ def run(process_id: int, num_processes: int, port: int,
     multihost_utils.sync_global_devices("session-events-gen2-done")
     sess.close_events()
 
+    # --- gang telemetry (ISSUE 7 acceptance): a scripted slow rank is flagged
+    # by the straggler report gathered over THIS control plane, and an
+    # events-triggered xprof window writes per-rank trace directories ------- #
+    import tempfile
+    import time as _time
+
+    from harp_tpu import telemetry
+    from harp_tpu.parallel import faults as pfaults
+    from harp_tpu.telemetry.gang import publish_straggler_report
+    from harp_tpu.telemetry.xprof import XprofController, request_xprof
+
+    # rank identity for the fault layer + per-rank telemetry files (the gang
+    # launcher exports this; mp_smoke processes are spawned bare)
+    os.environ["HARP_PROCESS_ID"] = str(process_id)
+    tele_dir = tempfile.mkdtemp(prefix=f"harp-tele-p{process_id}-")
+    telemetry.configure(tele_dir, interval=4)
+    # sustained straggler on rank 1: 60 ms at every boundary (faults grammar)
+    os.environ["HARP_FAULT"] = "slow@epoch=1:rank=1:ms=60"
+    for step in range(6):
+        t0 = _time.perf_counter()
+        pfaults.fire(step + 1)
+        telemetry.record_chunk("smoke", start=step, losses=[float(step)],
+                               wall_s=_time.perf_counter() - t0)
+    os.environ.pop("HARP_FAULT", None)
+    # k=1.5: a 2-member gang's median is the mean of both p50s, so the
+    # default k=2 can never flag (slow > 2*median iff slow > slow + fast)
+    report = publish_straggler_report(sess, tele_dir, k=1.5)
+    assert report["suspects"] == [1], report
+    assert report["num_ranks"] == num_processes, report
+    # every rank computed the same report; rank 0 also persisted it
+    if process_id == 0:
+        from harp_tpu.telemetry.gang import read_straggler_report
+
+        on_disk = read_straggler_report(tele_dir)
+        assert on_disk is not None and on_disk["suspects"] == [1], on_disk
+    # the per-rank JSONL exists and carries the smoke steps
+    telemetry.active().flush()
+    with open(os.path.join(tele_dir, f"rank{process_id}",
+                           "steps.jsonl")) as f:
+        lines = f.read().strip().splitlines()
+    assert len(lines) == 6, len(lines)
+
+    # xprof window: COLLECTIVE request (rank 0's payload wins — every rank
+    # traces into a per-rank dir under rank 0's telemetry root), opened at
+    # the next boundary, closed after 2 boundaries
+    ctrl = XprofController(sess, rank=process_id)
+    request_xprof(sess, steps=2, directory=os.path.join(tele_dir, "xprof"))
+    ctrl(1)
+    assert ctrl.tracing, "xprof request not picked up at the boundary"
+    ctrl(2)
+    ctrl(3)
+    assert not ctrl.tracing
+    found = [os.path.join(r, fn) for r, _, fns in os.walk(ctrl.trace_dir)
+             for fn in fns]
+    assert found, f"no trace files under {ctrl.trace_dir}"
+    multihost_utils.sync_global_devices("telemetry-smoke-done")
+    telemetry.disable()
+    sess.close_events()
+
     # --- barrier + teardown --------------------------------------------------- #
     sess.barrier()          # multihost branch: sync_global_devices
     distributed.shutdown()

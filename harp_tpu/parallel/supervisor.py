@@ -121,6 +121,26 @@ class _Journal:
                 f.flush()
 
 
+def _straggler_suspects(telemetry_dir: Optional[str]) -> Optional[dict]:
+    """The gang telemetry layer's straggler report, if one was published
+    (harp_tpu.telemetry.gang; rank 0 writes it next to the per-rank step
+    JSONL). The supervisor attaches it to its journal records so an
+    operator — or the future re-placement policy (ROADMAP: drop the suspect
+    and relaunch one member smaller) — sees WHICH rank was dragging the gang
+    at death, not just which rank died. Missing/torn file = no signal."""
+    if not telemetry_dir:
+        return None
+    from harp_tpu.telemetry.gang import read_straggler_report
+
+    report = read_straggler_report(telemetry_dir)
+    if report is None:
+        return None
+    return {"suspects": report.get("suspects", []),
+            "bsp_suspects": report.get("bsp_suspects", []),
+            "gang_median_p50_s": report.get("gang_median_p50_s"),
+            "report_ts": report.get("ts")}
+
+
 def _resumed_step(checkpoint_dir: Optional[str]) -> Optional[int]:
     if not checkpoint_dir:
         return None
@@ -142,6 +162,7 @@ def supervise(nodes: Sequence[launch_mod.Node], command: List[str], *,
               journal_path: Optional[str] = None,
               metrics=None,
               metrics_path: Optional[str] = None,
+              telemetry_dir: Optional[str] = None,
               sleep: Callable[[float], None] = time.sleep,
               echo: bool = False) -> SuperviseOutcome:
     """Run ``command`` as a gang under the elastic restart policy.
@@ -159,7 +180,8 @@ def supervise(nodes: Sequence[launch_mod.Node], command: List[str], *,
     return _supervise(attempt_fn, hosts, policy=policy,
                       checkpoint_dir=checkpoint_dir,
                       journal_path=journal_path, metrics=metrics,
-                      metrics_path=metrics_path, sleep=sleep, echo=echo)
+                      metrics_path=metrics_path,
+                      telemetry_dir=telemetry_dir, sleep=sleep, echo=echo)
 
 
 def supervise_local(command: List[str], *,
@@ -170,6 +192,7 @@ def supervise_local(command: List[str], *,
                     journal_path: Optional[str] = None,
                     metrics=None,
                     metrics_path: Optional[str] = None,
+                    telemetry_dir: Optional[str] = None,
                     sleep: Callable[[float], None] = time.sleep,
                     echo: bool = False) -> SuperviseOutcome:
     """Single-process flavor: supervise a plain subprocess (no gang env).
@@ -220,12 +243,13 @@ def supervise_local(command: List[str], *,
     return _supervise(attempt_fn, ["localhost"], policy=policy,
                       checkpoint_dir=checkpoint_dir,
                       journal_path=journal_path, metrics=metrics,
-                      metrics_path=metrics_path, sleep=sleep, echo=False)
+                      metrics_path=metrics_path,
+                      telemetry_dir=telemetry_dir, sleep=sleep, echo=False)
 
 
 def _supervise(attempt_fn, hosts: List[str], *, policy, checkpoint_dir,
-               journal_path, metrics, metrics_path, sleep,
-               echo) -> SuperviseOutcome:
+               journal_path, metrics, metrics_path, sleep, echo,
+               telemetry_dir=None) -> SuperviseOutcome:
     if metrics is None:
         from harp_tpu.utils.metrics import DEFAULT as metrics
     policy = policy or RestartPolicy()
@@ -261,6 +285,16 @@ def _supervise(attempt_fn, hosts: List[str], *, policy, checkpoint_dir,
                                     journal.records)
         metrics.count("supervisor.failures")
         metrics.count(f"supervisor.failures.{cause.value}")
+        # gang-telemetry straggler context (if the dead gang published one):
+        # attached to every failure record — a TIMEOUT whose report names a
+        # rank is a straggler dragging the gang, not a uniform stall
+        straggler = _straggler_suspects(telemetry_dir)
+        if straggler:
+            # bsp_suspects: the BSP fit-loop signature (the rank everyone
+            # else waits on — telemetry.gang.straggler_report docstring)
+            named = straggler["suspects"] or straggler["bsp_suspects"]
+            if named:
+                metrics.gauge("supervisor.last_straggler_suspect", named[0])
         if cause is FailureClass.WATCHDOG and rank is not None:
             watchdog_deaths[rank] += 1
             if watchdog_deaths[rank] >= policy.watchdog_suspect_after:
@@ -268,7 +302,8 @@ def _supervise(attempt_fn, hosts: List[str], *, policy, checkpoint_dir,
                                 "cause": cause.value, "first_rank": rank,
                                 "host": hosts[rank],
                                 "watchdog_deaths": watchdog_deaths[rank],
-                                "elapsed_s": elapsed})
+                                "elapsed_s": elapsed,
+                                "straggler": straggler})
                 metrics.count("supervisor.aborts.suspect_node")
                 _finish(metrics, metrics_path)
                 return SuperviseOutcome(False, attempt + 1, results,
@@ -289,7 +324,8 @@ def _supervise(attempt_fn, hosts: List[str], *, policy, checkpoint_dir,
                             "first_rc": rc,
                             "restarts": attempt,
                             "max_restarts": policy.max_restarts,
-                            "elapsed_s": elapsed})
+                            "elapsed_s": elapsed,
+                            "straggler": straggler})
             metrics.count("supervisor.aborts.budget")
             _finish(metrics, metrics_path)
             return SuperviseOutcome(False, attempt + 1, results,
@@ -302,6 +338,7 @@ def _supervise(attempt_fn, hosts: List[str], *, policy, checkpoint_dir,
             "host": hosts[rank] if rank is not None else None,
             "backoff_s": backoff, "resumed_step": resumed,
             "elapsed_s": elapsed, "timed_out": timed_out,
+            "straggler": straggler,
         })
         metrics.count("supervisor.restarts")
         metrics.count(f"supervisor.restarts.{cause.value}")
@@ -315,6 +352,19 @@ def _supervise(attempt_fn, hosts: List[str], *, policy, checkpoint_dir,
               file=sys.stderr, flush=True)
         sleep(backoff)
         attempt += 1
+
+
+def _command_flag(command: List[str], name: str) -> Optional[str]:
+    """Last ``--name V`` / ``--name=V`` in the supervised command, or None
+    (mirrors run._flag_value without importing run — the supervisor must
+    stay jax-free)."""
+    val = None
+    for i, tok in enumerate(command):
+        if tok == name and i + 1 < len(command):
+            val = command[i + 1]
+        elif tok.startswith(name + "="):
+            val = tok.split("=", 1)[1]
+    return val
 
 
 def _finish(metrics, metrics_path: Optional[str]) -> None:
@@ -379,6 +429,11 @@ def main(argv=None) -> int:
         journal_path=journal,
         metrics_path=(os.path.join(work, "supervisor_metrics.json")
                       if work else None),
+        # prefer the supervised command's own --telemetry-dir (where the
+        # gang actually publishes the straggler report); fall back to the
+        # work-dir convention
+        telemetry_dir=_command_flag(command, "--telemetry-dir")
+        or (os.path.join(work, "telemetry") if work else None),
         echo=True)
     restarts = sum(1 for r in outcome.journal if r.get("event") == "restart")
     status = "succeeded" if outcome.ok else f"gave up ({outcome.gave_up})"

@@ -58,6 +58,16 @@ def _common_flags(p: argparse.ArgumentParser) -> None:
                         "(parallel.supervisor; restart journal lands in "
                         "work-dir). Inside a gang this is handled by the "
                         "gang-level supervisor and ignored here.")
+    p.add_argument("--telemetry-dir", default="",
+                   help="enable gang telemetry (harp_tpu.telemetry): "
+                        "per-step JSONL events + comm-volume gauges land in "
+                        "DIR/rank<r>/, gang mode adds the straggler report "
+                        "and the events-triggered xprof window. Empty = off "
+                        "(zero overhead).")
+    p.add_argument("--telemetry-interval", type=int, default=16,
+                   help="telemetry cadence in CHUNK BOUNDARIES (count-based "
+                        "so gang ranks stay aligned): flush + gang straggler "
+                        "publish every N boundaries")
 
 
 def _session(args):
@@ -88,7 +98,36 @@ def _session(args):
         # gang mode: --num-workers sized this member's VIRTUAL device share
         # (the cpu-mesh flag above); the session always spans the global mesh
         n = len(jax.devices())
-    return HarpSession(num_workers=min(n, len(jax.devices())))
+    sess = HarpSession(num_workers=min(n, len(jax.devices())))
+    if getattr(args, "telemetry_dir", ""):
+        _enable_telemetry(sess, args.telemetry_dir, args.telemetry_interval)
+    return sess
+
+
+def _enable_telemetry(sess, directory: str, interval: int) -> None:
+    """Bring up the telemetry layer for this run (harp_tpu.telemetry):
+    per-step JSONL + comm gauges always; in gang mode also the straggler
+    publisher and the xprof window controller as chunk-boundary hooks —
+    count-based cadence, safe because every member runs the same SPMD host
+    loop (same argv, shared checkpoint state)."""
+    import jax
+
+    from harp_tpu import telemetry
+
+    log = telemetry.configure(directory, interval=interval)
+    if log is None:
+        return
+    from harp_tpu.telemetry.xprof import XprofController
+
+    # the operator trigger: `echo '{"steps": 20}' > DIR/xprof_request.json`
+    # while the job runs opens a window on every rank at its next boundary
+    log.add_boundary_hook(XprofController(
+        sess, trigger_path=os.path.join(directory, "xprof_request.json"),
+        default_dir=os.path.join(directory, "xprof")))
+    if jax.process_count() > 1:
+        from harp_tpu.telemetry.gang import GangCollector
+
+        log.add_boundary_hook(GangCollector(sess, directory))
 
 
 def _config_from_args(cls, ns, **overrides):
@@ -1140,6 +1179,7 @@ def _maybe_self_supervise(argv) -> Optional[int]:
                       if work else None),
         metrics_path=(os.path.join(work, "supervisor_metrics.json")
                       if work else None),
+        telemetry_dir=_flag_value(argv, "--telemetry-dir") or None,
         echo=True)
     if outcome.ok:
         return 0

@@ -1,0 +1,54 @@
+"""Gang-wide telemetry — per-step structured events, comm-volume accounting,
+straggler detection, and on-demand profiler windows.
+
+The reference's only observability was log4j inline wall-clock per phase
+(SURVEY §5: KMeansCollectiveMapper.java:190-195 per-iteration compute/merge/
+aggregate ms). This package is that idiom grown into a subsystem, under one
+hard constraint: **telemetry must never enter a jitted step program**. Every
+hook lives at the host chunk boundaries where the training loops ALREADY
+synchronize losses to the host (the ``fit_checkpointed`` chunk fetches, the
+final ``np.asarray`` of a scanned fit) — jaxlint's JL104 host-sync check and
+the JL201/JL203 collective-budget manifest are bitwise unchanged with
+telemetry on, and ``tools/ci_checks.sh`` gates exactly that.
+
+Layers:
+
+* :mod:`~harp_tpu.telemetry.step_log` — per-step structured events into a
+  bounded ring buffer, flushed as JSONL per rank. ``record_chunk`` is the one
+  call the models make; it is a single ``None``-check when telemetry is off.
+* :mod:`~harp_tpu.telemetry.comm_ledger` — wire-volume accounting priced off
+  the pinned collective-budget manifest (``tools/collective_budget.json``):
+  bytes/step, cumulative GB, achieved busbw as gauges, with quantized paths
+  priced at their quantized ``bytes_per_step`` rows. No hot-path
+  instrumentation — EQuARX-style measured wire bytes for free.
+* :mod:`~harp_tpu.telemetry.gang` — rank 0 collects per-rank
+  ``Metrics.snapshot()`` over the authenticated events control plane and
+  publishes a straggler report (suspect = sustained p50 step time > k× the
+  gang median) consumable by ``parallel.supervisor``.
+* :mod:`~harp_tpu.telemetry.xprof` — an ``events.send_collective`` payload
+  makes every rank capture a ``jax.profiler`` trace for the next N chunk
+  boundaries into a per-rank directory: profile a slow gang without
+  restarting it.
+
+Enable with ``harp_tpu.run ... --telemetry-dir DIR [--telemetry-interval N]``
+or programmatically via :func:`configure`; the ``HARP_TELEMETRY_DIR`` /
+``HARP_TELEMETRY_INTERVAL`` environment variables do the same for embedded
+callers (gang members inherit them from the launcher environment).
+"""
+
+from __future__ import annotations
+
+from harp_tpu.telemetry.comm_ledger import (CommLedger, ledger_for,
+                                            load_manifest, manifest_target)
+from harp_tpu.telemetry.gang import (gather_snapshots, publish_straggler_report,
+                                     straggler_report)
+from harp_tpu.telemetry.step_log import (StepLog, active, configure, disable,
+                                         phase, record_chunk)
+from harp_tpu.telemetry.xprof import XprofController, request_xprof
+
+__all__ = [
+    "CommLedger", "StepLog", "XprofController", "active", "configure",
+    "disable", "gather_snapshots", "ledger_for", "load_manifest",
+    "manifest_target", "phase", "publish_straggler_report", "record_chunk",
+    "request_xprof", "straggler_report",
+]

@@ -1,0 +1,170 @@
+"""Comm-volume accounting priced off the pinned collective-budget manifest.
+
+EQuARX (arXiv:2506.17615) treats wire bytes as a first-class measured
+quantity. This repo already PINS per-step collective operand bytes statically:
+``tools/collective_budget.json`` (jaxlint JL203) records, for every traced
+step program, the collective count/kind AND byte volume — including the
+quantized twins, whose rows sit far below their f32 counterparts. Runtime
+comm-volume telemetry therefore needs no hot-path instrumentation at all:
+join the host step counter with the model's manifest row and multiply.
+
+The manifest rows are traced at tier-1 shapes; a job at different shapes
+passes ``scale`` = (its per-step collective payload elements) / (the traced
+shape's) — for the stat-table workloads that ratio is exact for the dominant
+payload (K-means: the padded ``(k_pad, d_pad+1)`` f32 table via
+``KMeans.comm_scale``; the few-byte scalar-cost psum rides unscaled and is
+noise). Models that do NOT compute a scale (lda/sgd_mf/als/nn today) get
+TRACED-SHAPE pricing: the row is exact only at tier-1 shapes and otherwise a
+fixed per-step reference volume, NOT the job's true bytes. That distinction
+is machine-readable, not prose: ``exact=False`` ledgers publish
+``comm.<target>.pricing_exact = 0`` and stamp every step event's pricing
+field (step_log attaches ``wire_pricing: "traced_shape"``), so a dashboard
+cannot mistake a reference counter for a measurement.
+
+Gauges published into the metrics registry (visible in every
+``Metrics.snapshot()`` the gang layer exchanges)::
+
+    comm.<target>.wire_bytes_per_step    manifest-priced bytes per step
+    comm.<target>.cumulative_gb          bytes_per_step x steps / 1e9
+    comm.<target>.busbw_gbps             bytes moved / wall seconds (when the
+                                         boundary passes wall_s)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+MANIFEST_PATH = os.path.join(_REPO_ROOT, "tools", "collective_budget.json")
+
+_manifest_cache: Dict[str, dict] = {}
+
+
+def load_manifest(path: Optional[str] = None) -> dict:
+    """The pinned budget manifest (cached per path); ``{}`` targets when the
+    file is absent (an installed wheel without the tools tree) — the ledger
+    then prices nothing rather than crashing training."""
+    p = path or MANIFEST_PATH
+    if p not in _manifest_cache:
+        try:
+            with open(p) as f:
+                _manifest_cache[p] = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            _manifest_cache[p] = {"targets": {}}
+    return _manifest_cache[p]
+
+
+def manifest_target(model: str, *, comm: Optional[str] = None,
+                    quant: Optional[str] = None,
+                    sub_block: bool = False,
+                    manifest_path: Optional[str] = None) -> Optional[str]:
+    """Resolve a model config to its budget-manifest row name.
+
+    Quantized paths resolve to their quantized twin when the manifest pins
+    one (``kmeans_allreduce_int8``), falling back to the f32 row otherwise
+    (the counts still hold; the byte price is then an upper bound and the
+    fallback is recorded by the caller's gauge name staying unsuffixed).
+    Returns None when no row matches — the ledger stays inert.
+    """
+    targets = load_manifest(manifest_path).get("targets", {})
+    base = {
+        "kmeans": f"kmeans_{comm}" if comm else None,
+        "lda": "lda_cgs_subblock128" if sub_block else "lda_cgs",
+        "sgd_mf": "sgd_mf_dense",
+        "als": "als_explicit",
+        "nn": "nn_mlp",
+        "pagerank": "pagerank",
+    }.get(model)
+    if base is None:
+        return None
+    if quant:
+        suffixed = f"{base}_{quant}"
+        if suffixed in targets:
+            return suffixed
+    return base if base in targets else None
+
+
+class CommLedger:
+    """Step-counter -> wire-volume join against one manifest row."""
+
+    def __init__(self, target: Optional[str], *, scale: float = 1.0,
+                 exact: bool = False,
+                 manifest_path: Optional[str] = None, metrics=None):
+        if metrics is None:
+            from harp_tpu.utils.metrics import DEFAULT as metrics
+        self.metrics = metrics
+        self.target = target
+        self.steps = 0
+        self.wall_s = 0.0
+        self.scale = scale
+        # exact=True ONLY when the caller computed a real payload scale for
+        # its shapes (KMeans.comm_scale); False = traced-shape reference
+        # pricing, flagged in the gauges and step events
+        self.exact = exact
+        row = (load_manifest(manifest_path).get("targets", {}).get(target)
+               if target else None)
+        self.bytes_per_step: Optional[float] = (
+            row["bytes_per_step"] * scale
+            if row and "bytes_per_step" in row else None)
+        self.bytes_by_kind: Dict[str, float] = (
+            {k: v * scale for k, v in row.get("bytes_by_kind", {}).items()}
+            if row else {})
+
+    @property
+    def cumulative_bytes(self) -> float:
+        return (self.bytes_per_step or 0.0) * self.steps
+
+    def on_steps(self, n: int, wall_s: Optional[float] = None) -> None:
+        """Advance the counter by ``n`` steps (``wall_s``: the chunk's wall,
+        for the achieved-busbw gauge). Inert when no manifest row matched."""
+        if self.bytes_per_step is None or n <= 0:
+            return
+        self.steps += n
+        if wall_s:
+            self.wall_s += wall_s
+        pfx = f"comm.{self.target}"
+        self.metrics.gauge(f"{pfx}.pricing_exact", 1.0 if self.exact else 0.0)
+        self.metrics.gauge(f"{pfx}.wire_bytes_per_step", self.bytes_per_step)
+        self.metrics.gauge(f"{pfx}.cumulative_gb",
+                           self.cumulative_bytes / 1e9)
+        if self.wall_s > 0:
+            self.metrics.gauge(f"{pfx}.busbw_gbps",
+                               self.cumulative_bytes / self.wall_s / 1e9)
+
+    def snapshot(self) -> dict:
+        return {"target": self.target, "steps": self.steps,
+                "scale": self.scale, "exact": self.exact,
+                "bytes_per_step": self.bytes_per_step,
+                "cumulative_bytes": self.cumulative_bytes,
+                "bytes_by_kind": self.bytes_by_kind}
+
+
+def ledger_for(model: str, *, comm: Optional[str] = None,
+               quant: Optional[str] = None, sub_block: bool = False,
+               scale: Optional[float] = None, exact: Optional[bool] = None,
+               metrics=None) -> Optional[CommLedger]:
+    """A ledger for the model's manifest row — or None when telemetry is off
+    (so the models' fast path stays a single check) or no row matches.
+    ``scale=None`` means the caller did not compute a payload scale: the row
+    is traced-shape reference pricing and is flagged as such (class
+    docstring). Passing a scale claims exact pricing UNLESS ``exact=False``
+    overrides — a scale can be right for the payload shape but the traced
+    collective operands also depend on e.g. the worker count (K-means at
+    ``num_workers != 8`` passes its element ratio with ``exact=False``)."""
+    from harp_tpu.telemetry import step_log
+
+    log = step_log.active()
+    if log is None:
+        return None
+    target = manifest_target(model, comm=comm, quant=quant,
+                             sub_block=sub_block)
+    if target is None:
+        return None
+    if exact is None:
+        exact = scale is not None
+    return CommLedger(target, scale=1.0 if scale is None else scale,
+                      exact=exact,
+                      metrics=metrics if metrics is not None else log.metrics)

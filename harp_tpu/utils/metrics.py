@@ -5,7 +5,10 @@ Reference parity (SURVEY §5): Harp logged inline wall-clock per phase with log4
 JVM memory via ``logMemUsage``:686 and GC time via ``logGCTime``:696, and pool
 occupancy dumps. No metrics registry existed. Here: a process-local registry of
 counters/gauges/timers with the same phase-timing idiom, plus device-memory
-introspection replacing the JVM calls.
+introspection replacing the JVM calls. Timers keep a BOUNDED reservoir of
+samples (exact count/total/last; percentiles over a statistically uniform
+subsample), so a multi-day supervised job cannot grow RAM through its phase
+timers — the same bug class PR 1 fixed in ``supervise_local``'s capture buffer.
 """
 
 from __future__ import annotations
@@ -13,12 +16,67 @@ from __future__ import annotations
 import contextlib
 import json
 import logging
+import math
 import os
+import random
 import time
 from collections import defaultdict
-from typing import Dict
+from typing import Dict, Optional
 
 log = logging.getLogger("harp_tpu")
+
+# Bounded timer storage: enough samples that p99 over a uniform reservoir is
+# stable, small enough that thousands of timers stay in the low tens of MB.
+RESERVOIR_CAP = 2048
+
+
+class TimerReservoir:
+    """Bounded sample store for one timer.
+
+    ``count``/``total``/``last`` are EXACT over every observation; the sample
+    buffer holds at most ``cap`` values maintained as a uniform random
+    reservoir (Vitter's algorithm R), so percentiles stay representative of
+    the whole stream after the cap is reached. The RNG is seeded per
+    reservoir: snapshots are reproducible for a deterministic observation
+    stream.
+    """
+
+    __slots__ = ("count", "total", "last", "samples", "_cap", "_rng")
+
+    def __init__(self, cap: int = RESERVOIR_CAP, seed: int = 0):
+        self.count = 0
+        self.total = 0.0
+        self.last = 0.0
+        self.samples = []
+        self._cap = cap
+        self._rng = random.Random(seed)
+
+    def add(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        self.last = value
+        if len(self.samples) < self._cap:
+            self.samples.append(value)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < self._cap:
+                self.samples[j] = value
+
+    def percentile(self, q: float) -> float:
+        """Nearest-rank percentile over the reservoir (q in [0, 1])."""
+        return self.percentiles([q])[0]
+
+    def percentiles(self, qs) -> list:
+        """Several nearest-rank percentiles off ONE sort of the reservoir
+        (timing() asks for three; snapshot() calls timing() per timer at
+        every gang publish — re-sorting 2048 samples per quantile would
+        triple that cost for nothing)."""
+        if not self.samples:
+            return [float("nan")] * len(qs)
+        ordered = sorted(self.samples)
+        n = len(ordered)
+        return [ordered[min(n - 1, max(0, math.ceil(q * n) - 1))]
+                for q in qs]
 
 
 class Metrics:
@@ -27,13 +85,18 @@ class Metrics:
     def __init__(self):
         self.counters: Dict[str, float] = defaultdict(float)
         self.gauges: Dict[str, float] = {}
-        self.timers: Dict[str, list] = defaultdict(list)
+        self.timers: Dict[str, TimerReservoir] = defaultdict(TimerReservoir)
 
     def count(self, name: str, value: float = 1.0) -> None:
         self.counters[name] += value
 
     def gauge(self, name: str, value: float) -> None:
         self.gauges[name] = value
+
+    def observe(self, name: str, seconds: float) -> None:
+        """Record one timer sample directly (for durations measured by the
+        caller — e.g. the telemetry layer's amortized per-step times)."""
+        self.timers[name].add(seconds)
 
     @contextlib.contextmanager
     def timer(self, name: str):
@@ -46,14 +109,16 @@ class Metrics:
         try:
             yield
         finally:
-            self.timers[name].append(time.perf_counter() - t0)
+            self.observe(name, time.perf_counter() - t0)
 
     def timing(self, name: str) -> Dict[str, float]:
-        ts = self.timers.get(name, [])
-        if not ts:
+        r = self.timers.get(name)
+        if r is None or not r.count:
             return {}
-        return {"count": len(ts), "total_s": sum(ts),
-                "mean_s": sum(ts) / len(ts), "last_s": ts[-1]}
+        p50, p90, p99 = r.percentiles([0.50, 0.90, 0.99])
+        return {"count": r.count, "total_s": r.total,
+                "mean_s": r.total / r.count, "last_s": r.last,
+                "p50_s": p50, "p90_s": p90, "p99_s": p99}
 
     def snapshot(self) -> Dict[str, object]:
         return {
@@ -71,10 +136,13 @@ class Metrics:
             json.dump(self.snapshot(), f, indent=2, sort_keys=True)
 
     def log_summary(self) -> None:
-        for name, t in sorted(self.timers.items()):
+        for name in sorted(self.timers):
             s = self.timing(name)
-            log.info("timer %-24s n=%d total=%.3fs mean=%.4fs",
-                     name, s["count"], s["total_s"], s["mean_s"])
+            if not s:
+                continue
+            log.info("timer %-24s n=%d total=%.3fs mean=%.4fs p50=%.4fs "
+                     "p99=%.4fs", name, s["count"], s["total_s"], s["mean_s"],
+                     s["p50_s"], s["p99_s"])
         for name, v in sorted(self.counters.items()):
             log.info("counter %-22s %.0f", name, v)
 
@@ -82,20 +150,36 @@ class Metrics:
 DEFAULT = Metrics()
 
 
-def log_device_mem_usage() -> Dict[str, int]:
+def log_device_mem_usage(metrics: Optional[Metrics] = None
+                         ) -> Dict[str, Dict[str, int]]:
     """Device-memory introspection (replaces CollectiveMapper.logMemUsage:686 /
-    logGCTime:696 — there is no GC on the device; HBM stats stand in)."""
+    logGCTime:696 — there is no GC on the device; HBM stats stand in).
+
+    Returns ``{device: {"bytes_in_use": ..., "peak_bytes_in_use": ...}}`` and,
+    when a ``metrics`` registry is passed, gauges both values per device.
+    Backends without the introspection raise ``NotImplementedError`` (CPU) or
+    an ``XlaRuntimeError`` (a ``RuntimeError`` subclass, e.g. remote tunnels
+    mid-teardown); those devices are skipped, anything else propagates.
+    """
     import jax           # deferred: registry users (the gang supervisor) must
     #                      not pay a backend init just to count restarts
 
-    out = {}
+    out: Dict[str, Dict[str, int]] = {}
     for d in jax.devices():
         try:
             stats = d.memory_stats()
-        except Exception:
+        except (NotImplementedError, RuntimeError):
             continue
         if stats:
-            out[str(d)] = stats.get("bytes_in_use", 0)
-            log.info("device %s: %d bytes in use", d,
-                     stats.get("bytes_in_use", 0))
+            row = {"bytes_in_use": int(stats.get("bytes_in_use", 0)),
+                   "peak_bytes_in_use": int(stats.get(
+                       "peak_bytes_in_use", stats.get("bytes_in_use", 0)))}
+            out[str(d)] = row
+            if metrics is not None:
+                metrics.gauge(f"device.{d.id}.bytes_in_use",
+                              row["bytes_in_use"])
+                metrics.gauge(f"device.{d.id}.peak_bytes_in_use",
+                              row["peak_bytes_in_use"])
+            log.info("device %s: %d bytes in use (peak %d)", d,
+                     row["bytes_in_use"], row["peak_bytes_in_use"])
     return out

@@ -17,11 +17,23 @@ import jax
 @contextlib.contextmanager
 def trace(log_dir: str):
     """Capture a profiler trace for the enclosed block."""
-    jax.profiler.start_trace(log_dir)
+    start_trace(log_dir)
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        stop_trace()
+
+
+def start_trace(log_dir: str) -> None:
+    """Open a trace capture (split form of :func:`trace` — for windows that
+    span host loop boundaries, e.g. the gang telemetry layer's on-demand
+    xprof windows, telemetry/xprof.py)."""
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_trace() -> None:
+    """Close the capture opened by :func:`start_trace`."""
+    jax.profiler.stop_trace()
 
 
 def annotate(name: str):

@@ -1,0 +1,64 @@
+"""utils.tracing coverage (ISSUE 7 satellite — the module had zero tests).
+
+CPU-backend smoke: the jax profiler runs fine on the virtual CPU mesh, so
+trace capture, timeline annotation, and the device-memory profile are all
+exercised for real (file artifacts asserted, not just "didn't raise")."""
+
+import os
+
+import jax.numpy as jnp
+
+from harp_tpu.utils import tracing
+
+
+def _files_under(root):
+    return [os.path.join(r, f) for r, _, fs in os.walk(root) for f in fs]
+
+
+def test_trace_produces_a_trace_directory(tmp_path):
+    d = str(tmp_path / "trace")
+    with tracing.trace(d):
+        jnp.square(jnp.arange(128.0)).block_until_ready()
+    found = _files_under(d)
+    # the profiler writes plugins/profile/<ts>/*.xplane.pb (+ a trace json)
+    assert found, f"no trace artifacts under {d}"
+    assert any(f.endswith(".xplane.pb") for f in found), found
+
+
+def test_trace_closes_on_exception(tmp_path):
+    # the finally must stop the trace — a second capture would otherwise
+    # die with "profiler already started"
+    d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+    try:
+        with tracing.trace(d1):
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    with tracing.trace(d2):
+        jnp.ones(8).block_until_ready()
+    assert _files_under(d2)
+
+
+def test_split_start_stop_spans_host_boundaries(tmp_path):
+    # the xprof-window form: open at one loop boundary, close at a later one
+    d = str(tmp_path / "window")
+    tracing.start_trace(d)
+    for _ in range(3):
+        jnp.sum(jnp.arange(32.0)).block_until_ready()
+    tracing.stop_trace()
+    assert _files_under(d)
+
+
+def test_annotate_wraps_a_host_span(tmp_path):
+    d = str(tmp_path / "trace")
+    with tracing.trace(d):
+        with tracing.annotate("harp-test-span"):
+            jnp.sum(jnp.ones(16)).block_until_ready()
+    assert _files_under(d)
+
+
+def test_device_memory_profile_writes_a_file(tmp_path):
+    p = str(tmp_path / "mem.pprof")
+    jnp.ones(1024).block_until_ready()
+    tracing.device_memory_profile(p)
+    assert os.path.isfile(p) and os.path.getsize(p) > 0

@@ -8,8 +8,14 @@
 #                     operand BYTES incl. the quantized trace targets — a
 #                     quantized path silently reverting to f32 fails here);
 #                     nonzero on any finding or stale allowlist entry.
-#   2. check_claims — README/PERF headline numbers vs BENCH_local.json.
-#   3. tier-1       — the ROADMAP.md verify suite (which itself re-runs
+#   2. telemetry    — the jaxpr engine re-run with the gang telemetry layer
+#                     ENABLED (HARP_TELEMETRY_DIR set): the instrumented
+#                     step programs must reproduce the pinned manifest
+#                     exactly — telemetry is host-boundary-only by design,
+#                     and this gate makes that a checked contract, not a
+#                     comment (ISSUE 7).
+#   3. check_claims — README/PERF headline numbers vs BENCH_local.json.
+#   4. tier-1       — the ROADMAP.md verify suite (which itself re-runs
 #                     jaxlint's clean-repo + budget checks as tests, so
 #                     DOTS_PASSED captures them).
 #
@@ -20,16 +26,20 @@ set -u
 cd "$(dirname "$0")/.."
 rc=0
 
-echo "== [1/3] jaxlint =="
+echo "== [1/4] jaxlint =="
 python -m tools.jaxlint || rc=1
 
-echo "== [2/3] check_claims =="
+echo "== [2/4] jaxlint budget with telemetry ON (zero drift) =="
+tele_dir="$(mktemp -d /tmp/_tele_gate.XXXXXX)"
+HARP_TELEMETRY_DIR="$tele_dir" python -m tools.jaxlint --jaxpr-only || rc=1
+
+echo "== [3/4] check_claims =="
 python tools/check_claims.py || rc=1
 
-echo "== [3/3] tier-1 tests =="
+echo "== [4/4] tier-1 tests =="
 set -o pipefail
 t1_log="$(mktemp /tmp/_t1.XXXXXX.log)"   # unique per run: concurrent CI
-trap 'rm -f "$t1_log"' EXIT              # jobs must not clobber the count
+trap 'rm -f "$t1_log"; rm -rf "$tele_dir"' EXIT   # must not clobber the count
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly 2>&1 | tee "$t1_log" || rc=1

@@ -84,10 +84,6 @@ ALLOWLIST: Allowlist = {
         "resume-time payload reads of possibly-corrupt steps: any "
         "load/parse error means 'skip this step and try the previous "
         "one' — crashing here would defeat the elastic-restart journal",
-    ("harp_tpu/utils/metrics.py", "log_device_mem_usage", "JL105"):
-        "memory_stats() is optional per backend and raises "
-        "backend-specific errors on platforms that lack it; metrics "
-        "logging must never take down the training process",
     ("harp_tpu/benchmark/scaling.py", "measure", "JL105"):
         "sweep harness: one failing width config must record its error "
         "string and let the remaining grid points run (bench must not "
