@@ -353,8 +353,15 @@ class KMeans:
         chunked program runs the identical per-iteration math as the full
         scan, so interrupted + resumed trajectories are bitwise identical to
         uninterrupted ones. Returns (centroids, costs-for-run-iterations,
-        start_iteration)."""
+        start_iteration).
+
+        World-size-agnostic: the centroid table is REPLICATED, so a
+        checkpoint written by a W-worker gang restores EXACTLY into a
+        W' != W gang (the supervisor's shrink-relaunch path) — only the
+        point shards re-split, which prepare() does per run. The manifest
+        meta records the writing world for the journal/debugging."""
         from harp_tpu.parallel import faults
+        from harp_tpu.utils import checkpoint as ckpt_lib
 
         total = iterations if iterations is not None else \
             self.config.iterations
@@ -406,7 +413,10 @@ class KMeans:
                                    extra={"comm": self.config.comm})
             it += chunk
             with telemetry.phase("kmeans.checkpoint"):
-                checkpointer.save(it, {"centroids": np.asarray(cen)})
+                save_state = {"centroids": np.asarray(cen)}
+                checkpointer.save(it, save_state, meta=ckpt_lib.state_meta(
+                    save_state, model="kmeans",
+                    world=self.session.num_workers))
         if hasattr(checkpointer, "wait"):
             checkpointer.wait()       # surface a failed async final write
         return cen, np.asarray(costs, np.float32), start

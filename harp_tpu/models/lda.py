@@ -704,32 +704,80 @@ class LDA:
         is bitwise identical to an uninterrupted fit_checkpointed run at the
         same ``save_every`` (the trajectory differs from a single full-scan
         :meth:`fit_prepared` only in the per-chunk RNG folding). Returns
-        (doc_topic, word_topic-unpermuted, ll-for-run-epochs, start_epoch)."""
+        (doc_topic, word_topic-unpermuted, ll-for-run-epochs, start_epoch).
+
+        World-size-agnostic: besides the chain state the checkpoint stores
+        the blocked corpus layout (token slots + mask + vocab id maps) and a
+        manifest meta naming the writing world. A resume under a different
+        worker count (the supervisor's shrink/re-place relaunch) restores
+        with the SAVED shapes and re-matches every token's assignment onto
+        this session's blocking by its (doc, vocab-id) key
+        (collectives.repartition.rematch_tokens — exact up to the
+        exchangeability of same-word-same-doc occurrences, under which all
+        Gibbs counts are invariant), then rebuilds the word-topic counts at
+        the new layout. Same-world resume takes the historical bitwise path
+        untouched."""
         sess, cfg = self.session, self.config
         key, data, seed, (word_block, word_slot, vpb) = state
         docs_b, mask_b, z_cur, wt_cur = data
         from harp_tpu.parallel import faults
+        from harp_tpu.utils import checkpoint as ckpt_lib
 
+        w, v_pad, lb, num_docs = key[:4]
+        lbs = key[6] if len(key) > 6 else 0
         total = epochs if epochs is not None else cfg.epochs
         start = 0
+        # the blocked-layout leaves ride in EVERY checkpoint so a DIFFERENT
+        # world can recover (doc, vocab-id) per token; the corpus is static,
+        # so these fetches happen once. Deliberate size tradeoff: each step
+        # dir stays fully self-contained (the keep-last-N pruning and the
+        # corrupt-step-skip fallback both assume any single step restores
+        # alone), at the cost of re-writing the static layout (~2x the z
+        # payload for CGS) per save
+        layout_leaves = {
+            "docs": fetch(docs_b),
+            "mask": fetch(mask_b).astype(np.uint8),
+            "word_block": np.asarray(word_block, np.int32),
+            "word_slot": np.asarray(word_slot, np.int32),
+        }
+        # meta-less (pre-elastic) steps hold only {z, wt} — restore them
+        # through the legacy template so same-world resume of an old work
+        # dir keeps working (a world CHANGE on one raises the clear
+        # no-metadata error in _repartition_chain)
+        legacy_like = {"z": np.zeros(z_cur.shape, z_cur.dtype),
+                       "wt": np.zeros(wt_cur.shape, wt_cur.dtype)}
         # verified resume, single read: manifest-checksummed steps only (a
         # corrupt newest checkpoint falls back to the previous step,
         # utils.checkpoint). `like` only conveys tree structure + dtypes:
-        # host zeros, not a full D2H gather of the device arrays (advisor r3)
-        resume, saved = checkpointer.restore_latest_valid(
-            like={"z": np.zeros(z_cur.shape, z_cur.dtype),
-                  "wt": np.zeros(wt_cur.shape, wt_cur.dtype)})
+        # host zeros, not a full D2H gather of the device arrays (advisor
+        # r3). A step written at another world size restores through a
+        # template with the SAVED shapes (its manifest meta).
+        resume, saved, ck_meta = checkpointer.restore_latest_valid(
+            like_from_meta=lambda m: (ckpt_lib.meta_like(m) if m
+                                      else legacy_like),
+            return_meta=True)
         if resume is not None:
             start = resume
+            if ck_meta is not None and ck_meta.get("model") not in (None,
+                                                                    "lda"):
+                # the template followed the SAVED shapes, so the leaf-count
+                # guard cannot catch a wrong-model work dir anymore — the
+                # recorded model name does
+                raise ValueError(
+                    f"checkpoint in this work dir was written by model "
+                    f"{ck_meta['model']!r}, not lda — wrong work dir?")
             if start > total:
                 raise ValueError(
                     f"checkpoint at epoch {start} exceeds the requested "
                     f"{total} epochs (pass a fresh directory or a larger "
                     f"budget)")
+            if (int(ck_meta["world"]) != w if ck_meta and "world" in ck_meta
+                    else np.shape(saved["z"]) != tuple(z_cur.shape)):
+                saved = self._repartition_chain(saved, ck_meta,
+                                                layout_leaves, vpb,
+                                                tuple(z_cur.shape))
             z_cur = sess.scatter(jnp.asarray(saved["z"]))
             wt_cur = sess.scatter(jnp.asarray(saved["wt"]))
-        w, v_pad, lb, num_docs = key[:4]
-        lbs = key[6] if len(key) > 6 else 0
         chunk_fns = {}
         lls = []
         doc_topic = None
@@ -760,8 +808,12 @@ class LDA:
                                    wall_s=wall, ledger=ledger)
             ep += chunk
             with telemetry.phase("lda.checkpoint"):
-                checkpointer.save(ep, {"z": fetch(z_cur),
-                                       "wt": fetch(wt_cur)})
+                save_state = {"z": fetch(z_cur), "wt": fetch(wt_cur),
+                              **layout_leaves}
+                checkpointer.save(ep, save_state, meta=ckpt_lib.state_meta(
+                    save_state, model="lda", world=w,
+                    num_model_slices=cfg.num_model_slices, vpb=vpb,
+                    vocab=cfg.vocab, method=cfg.method))
         if hasattr(checkpointer, "wait"):
             checkpointer.wait()       # surface a failed async final write
         wt_out = fetch(wt_cur)
@@ -781,6 +833,76 @@ class LDA:
                 dt = (np.eye(cfg.num_topics, dtype=np.float32)[z_h]
                       * m_h[..., None]).sum(axis=(1, 2))
         return dt, wt_final, np.asarray(lls, np.float32), start
+
+
+    def _repartition_chain(self, saved: dict, ck_meta, new_layout: dict,
+                           vpb: int, new_z_shape: tuple) -> dict:
+        """Chain state written at another world size → this session's
+        blocked layout. Every token's topic assignment is re-matched onto
+        the new blocking by its (doc, vocab-id) key
+        (collectives.repartition.rematch_tokens) and the word-topic counts
+        are rebuilt from the matched assignments exactly as prepare() built
+        them from the init — so (doc-topic, word-topic, topic-total) counts
+        transfer EXACTLY, the only freedom being the exchangeable order of
+        same-word-same-doc occurrences. Host-side numpy, once per resume:
+        no collective is traced or added to any step program (jaxlint
+        JL201/JL203 budgets stay bitwise)."""
+        from harp_tpu.collectives import repartition as rep
+
+        cfg = self.config
+        if ck_meta is None or "world" not in ck_meta:
+            raise ValueError(
+                "checkpoint does not match this session's chain shapes and "
+                "carries no world metadata (written by a pre-elastic "
+                "version?) — resume at the original worker count")
+        if int(ck_meta.get("num_model_slices", 1)) != 1 \
+                or cfg.num_model_slices != 1:
+            raise ValueError(
+                "world-size-agnostic resume supports num_model_slices=1 "
+                "only (the 2-slice wt layout interleaves worker-major "
+                f"half-slices); checkpoint has "
+                f"{ck_meta.get('num_model_slices')}, this config "
+                f"{cfg.num_model_slices}")
+        if int(ck_meta.get("vocab", cfg.vocab)) != cfg.vocab \
+                or str(ck_meta.get("method", cfg.method)) != cfg.method:
+            raise ValueError(
+                f"checkpoint chain (vocab={ck_meta.get('vocab')}, "
+                f"method={ck_meta.get('method')}) does not describe this "
+                f"model (vocab={cfg.vocab}, method={cfg.method})")
+        nb_old = int(ck_meta["world"])
+        vpb_old = int(ck_meta["vpb"])
+        nb_new = int(new_z_shape[1])
+
+        def inverse(wb, ws, nb, width):
+            inv = np.full((nb, width), -1, np.int64)
+            inv[np.asarray(wb, np.int64),
+                np.asarray(ws, np.int64)] = np.arange(len(wb))
+            return inv
+
+        inv_old = inverse(saved["word_block"], saved["word_slot"], nb_old,
+                          vpb_old)
+        inv_new = inverse(new_layout["word_block"], new_layout["word_slot"],
+                          nb_new, vpb)
+        od, ob, op = np.nonzero(np.asarray(saved["mask"]) > 0)
+        v_old = inv_old[ob, np.asarray(saved["docs"])[od, ob, op]]
+        nd, nb_i, np_i = np.nonzero(np.asarray(new_layout["mask"]) > 0)
+        slots_new = np.asarray(new_layout["docs"])[nd, nb_i, np_i]
+        v_new = inv_new[nb_i, slots_new]
+        if len(v_old) and v_old.min() < 0 or len(v_new) and v_new.min() < 0:
+            raise ValueError(
+                "blocked corpus references slots outside its vocab id maps "
+                "— the checkpoint layout leaves are inconsistent")
+        matched = rep.rematch_tokens(
+            od, v_old, np.asarray(saved["z"])[od, ob, op], nd, v_new)
+        z_new = np.zeros(new_z_shape, np.asarray(saved["z"]).dtype)
+        z_new[nd, nb_i, np_i] = matched
+        # rebuild word-topic counts at the new blocking (prepare's formula)
+        k = cfg.num_topics
+        contrib = (matched if cfg.method == "cvb0"
+                   else np.eye(k, dtype=np.float32)[matched])
+        wt = np.zeros((nb_new, vpb, k), np.float32)
+        np.add.at(wt, (nb_i, slots_new), contrib)
+        return {**saved, "z": z_new, "wt": wt.reshape(nb_new * vpb, k)}
 
 
 # --------------------------------------------------------------------------- #

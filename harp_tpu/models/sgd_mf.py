@@ -771,32 +771,79 @@ class SGDMF:
         The training math is deterministic given (data, factors), so an
         interrupted + resumed run produces exactly the trajectory of an
         uninterrupted run at the same per-epoch program granularity.
+
+        World-size-agnostic: the checkpoint stores the factors in this
+        world's permuted block layout PLUS the (bin, slot) id maps and a
+        manifest meta naming the writing world. Resuming under a different
+        worker count (the supervisor's shrink/re-place relaunch) restores
+        with the SAVED shapes and gather-and-resplits both factor tables
+        onto this session's layout (collectives.repartition) — exact for
+        every id the ratings reference, host-side, no collectives added to
+        any step program. Same-world resume takes the historical bitwise
+        path untouched.
         """
         from harp_tpu.parallel import faults
+        from harp_tpu.utils import checkpoint as ckpt_lib
 
         layout, data, w0, h0, meta = state
+        num_rows, num_cols, row_assign, col_assign = meta[:4]
         geom = meta[6]
         nmb = self.config.minibatches_per_hop
         epochs = epochs if epochs is not None else self.config.epochs
         w_cur, h_cur = w0, h0
         start = 0
+        world = self.session.num_workers
+        # the id maps ride in every checkpoint so a DIFFERENT world can
+        # de-permute to canonical id order (maps are deterministic given the
+        # data, but only for the world that computed them)
+        assign_leaves = {
+            "row_bin": np.asarray(row_assign[0][:num_rows], np.int32),
+            "row_slot": np.asarray(row_assign[1][:num_rows], np.int32),
+            "col_bin": np.asarray(col_assign[0][:num_cols], np.int32),
+            "col_slot": np.asarray(col_assign[1][:num_cols], np.int32),
+        }
+        # meta-less (pre-elastic) steps hold only the factor pair — restore
+        # them through the legacy template so same-world resume of an old
+        # work dir keeps working (a world CHANGE on one raises the clear
+        # no-metadata error in _repartition_saved)
+        legacy_like = {"w": np.zeros(w0.shape, w0.dtype),
+                       "h": np.zeros(h0.shape, h0.dtype)}
         # verified resume, single read: manifest-checksummed steps only (a
         # corrupt newest checkpoint falls back to the previous step,
         # utils.checkpoint). `like` only conveys tree structure + dtypes:
-        # host zeros, not a full (gang-collective) D2H gather of the factors
-        resume, saved = checkpointer.restore_latest_valid(
-            like={"w": np.zeros(w0.shape, w0.dtype),
-                  "h": np.zeros(h0.shape, h0.dtype)})
+        # host zeros, not a full (gang-collective) D2H gather of the
+        # factors. A step written at another world size restores through a
+        # template with the SAVED shapes (its manifest meta), then
+        # re-partitions below.
+        resume, saved, ck_meta = checkpointer.restore_latest_valid(
+            like_from_meta=lambda m: (ckpt_lib.meta_like(m) if m
+                                      else legacy_like),
+            return_meta=True)
         if resume is not None:
             start = resume
+            if ck_meta is not None and ck_meta.get("model") not in (
+                    None, "sgd_mf"):
+                # the template followed the SAVED shapes, so the leaf-count
+                # guard cannot catch a wrong-model work dir anymore — the
+                # recorded model name does
+                raise ValueError(
+                    f"checkpoint in this work dir was written by model "
+                    f"{ck_meta['model']!r}, not sgd_mf — wrong work dir?")
             if start > epochs:
                 raise ValueError(
                     f"checkpoint at epoch {start} exceeds the requested "
                     f"{epochs} epochs — the saved model is already trained "
                     f"past this budget (pass a fresh checkpoint directory "
                     f"or a larger epochs)")
-            w_cur = jax.device_put(saved["w"], w0.sharding)
-            h_cur = jax.device_put(saved["h"], h0.sharding)
+            # shape equality is NOT world equality (64 rows block to 8x8 or
+            # 4x16): trust the recorded world, fall back to shapes for
+            # meta-less legacy steps
+            if (int(ck_meta["world"]) != world if ck_meta
+                    and "world" in ck_meta
+                    else np.shape(saved["w"]) != tuple(w0.shape)):
+                saved = self._repartition_saved(saved, ck_meta, state)
+            w_cur = jax.device_put(np.asarray(saved["w"]), w0.sharding)
+            h_cur = jax.device_put(np.asarray(saved["h"]), h0.sharding)
         key = self._program(layout, nmb, 1, geom)
         fn = self._compiled[key]
         rmses = []
@@ -817,12 +864,65 @@ class SGDMF:
                                    wall_s=wall, ledger=ledger)
             if (epoch + 1) % save_every == 0 or epoch + 1 == epochs:
                 with telemetry.phase("sgd_mf.checkpoint"):
-                    checkpointer.save(epoch + 1, {"w": fetch(w_cur),
-                                                  "h": fetch(h_cur)})
+                    save_state = {"w": fetch(w_cur), "h": fetch(h_cur),
+                                  **assign_leaves}
+                    checkpointer.save(
+                        epoch + 1, save_state,
+                        meta=ckpt_lib.state_meta(
+                            save_state, model="sgd_mf", world=world,
+                            num_rows=num_rows, num_cols=num_cols,
+                            num_slices=self.config.num_slices,
+                            layout=layout))
         if hasattr(checkpointer, "wait"):
             checkpointer.wait()     # surface a failed async final write
         w_final, h_final = self._finalize(w_cur, h_cur, meta)
         return w_final, h_final, np.asarray(rmses), start
+
+    def _repartition_saved(self, saved: dict, ck_meta: Optional[dict],
+                           state) -> dict:
+        """Factor state written at another world size → this session's
+        layout (collectives.repartition): de-permute W/H to canonical id
+        order with the SAVED (bin, slot) maps, re-permute with this
+        prepare()'s maps. Exact for every id the ratings reference; padded
+        slots keep this run's fresh init (training math never reads them —
+        their counts are zero, so neither gradients nor the regularizer
+        move them). Host-side numpy, run once at resume: no collective is
+        traced or added to any step program, so the jaxlint per-step
+        budgets (JL201/JL203) stay bitwise."""
+        from harp_tpu.collectives import repartition as rep
+
+        layout, data, w0, h0, meta = state
+        num_rows, num_cols, row_assign, col_assign, rpw, cpb = meta[:6]
+        if ck_meta is None or "world" not in ck_meta:
+            raise ValueError(
+                "checkpoint does not match this session's factor shapes and "
+                "carries no world metadata (written by a pre-elastic "
+                "version?) — resume at the original worker count")
+        old_world = int(ck_meta["world"])
+        if int(ck_meta.get("num_slices", 1)) != 1 \
+                or self.config.num_slices != 1:
+            raise ValueError(
+                "world-size-agnostic resume supports num_slices=1 only "
+                "(the 2-slice H layout interleaves worker-major "
+                f"half-slices); checkpoint has num_slices="
+                f"{ck_meta.get('num_slices')}, this config "
+                f"{self.config.num_slices}")
+        if (int(ck_meta.get("num_rows", num_rows)) != num_rows
+                or int(ck_meta.get("num_cols", num_cols)) != num_cols):
+            raise ValueError(
+                f"checkpoint was written for a "
+                f"{ck_meta.get('num_rows')}x{ck_meta.get('num_cols')} "
+                f"rating matrix; this run prepared {num_rows}x{num_cols} — "
+                f"not the same dataset")
+        old_rpw = np.shape(saved["w"])[0] // old_world
+        old_cpb = np.shape(saved["h"])[0] // old_world
+        w_new = rep.repartition_factor(
+            saved["w"], (saved["row_bin"], saved["row_slot"]), old_rpw,
+            row_assign, rpw, num_rows, fetch(w0))
+        h_new = rep.repartition_factor(
+            saved["h"], (saved["col_bin"], saved["col_slot"]), old_cpb,
+            col_assign, cpb, num_cols, fetch(h0))
+        return {**saved, "w": w_new, "h": h_new}
 
     def fit(self, rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
             num_rows: int, num_cols: int, seed: int = 0

@@ -12,6 +12,15 @@ Grammar: comma-separated specs, each ``<kind>@key=value[:key=value...]``.
 
 kinds
     ``crash``        ``os._exit(FAULT_CRASH_EXIT)`` — a hard member death.
+    ``vanish``       ``os._exit(FAULT_VANISH_EXIT)`` — the member stops
+                     answering AND its host is to be treated as unreachable
+                     (machine rebooted, NIC died, preempted VM). The
+                     supervisor classifies this exit as a VANISHED host and
+                     applies the re-placement policy (swap in a spare /
+                     shrink the gang) instead of relaunching onto the dead
+                     host. This makes every re-placement scenario
+                     scriptable and deterministically testable, same as the
+                     crash/hang grammar.
     ``hang``         sleep forever — exercises the watchdog / launch timeout.
     ``ckpt-corrupt`` flip bytes in the newest completed checkpoint's
                      ``arrays.npz`` — exercises the manifest-checksum
@@ -31,7 +40,12 @@ keys
                   (sustained — a one-boundary hiccup must not look like a
                   straggler to the detector it exists to test).
     ``rank=R``    only this gang member fires (HARP_PROCESS_ID; a process
-                  outside a gang is rank 0). Omitted = every rank.
+                  outside a gang is rank 0). Omitted = every rank. When the
+                  world size is known (HARP_NUM_PROCESSES, or an explicit
+                  ``world_size=`` to :func:`parse_faults`), an out-of-range
+                  R is rejected LOUDLY at parse time — a fault that could
+                  never fire is a scripting bug, and silently not injecting
+                  it would let the scenario "pass" untested.
     ``attempt=A`` only fire on supervisor attempt A (HARP_GANG_ATTEMPT,
                   0 outside the supervisor). Default 0 — the fault fires on
                   the first launch and NOT again after a relaunch, which is
@@ -53,7 +67,11 @@ import time
 from typing import List, Optional
 
 FAULT_CRASH_EXIT = 41      # distinct from the watchdog's 98: a scripted death
-_KINDS = ("crash", "hang", "ckpt-corrupt", "slow")
+FAULT_VANISH_EXIT = 86     # scripted "host gone": member exits and the
+#                            supervisor must treat its HOST as unreachable
+#                            (re-place onto a spare / shrink, never relaunch
+#                            onto it)
+_KINDS = ("crash", "vanish", "hang", "ckpt-corrupt", "slow")
 SLOW_DEFAULT_MS = 100
 
 
@@ -66,9 +84,24 @@ class FaultSpec:
     ms: int = SLOW_DEFAULT_MS       # slow only: per-boundary sleep
 
 
-def parse_faults(text: str) -> List[FaultSpec]:
+def parse_faults(text: str,
+                 world_size: Optional[int] = None) -> List[FaultSpec]:
     """Parse the ``HARP_FAULT`` grammar; raises ValueError with the offending
-    token so a typo fails the job loudly instead of silently not injecting."""
+    token so a typo fails the job loudly instead of silently not injecting.
+
+    ``world_size`` (default: HARP_NUM_PROCESSES when the gang launcher set
+    it) bounds ``rank=``: a spec naming rank >= world size could never fire
+    — reject it at parse time, on every boundary, instead of letting the
+    scripted scenario silently run fault-free. Exemption: a spec already
+    DISARMED by attempt gating (its ``attempt`` != HARP_GANG_ATTEMPT) is
+    not range-checked — after the supervisor shrinks the gang, the very
+    spec that vanished the old top rank is still in the environment of the
+    smaller relaunch, and bricking that relaunch would defeat the
+    re-placement it scripted."""
+    if world_size is None:
+        env_world = os.environ.get("HARP_NUM_PROCESSES")
+        world_size = int(env_world) if env_world else None
+    cur_attempt = int(os.environ.get("HARP_GANG_ATTEMPT", "0"))
     specs = []
     for part in filter(None, (p.strip() for p in text.split(","))):
         if "@" not in part:
@@ -92,6 +125,17 @@ def parse_faults(text: str) -> List[FaultSpec]:
         if "ms" in kv and kind != "slow":
             raise ValueError(f"fault spec {part!r}: ms= applies to slow "
                              f"faults only")
+        rank = kv.get("rank")
+        armed = kv.get("attempt", 0) == cur_attempt
+        if rank is not None and (rank < 0 or (world_size is not None
+                                              and armed
+                                              and rank >= world_size)):
+            bound = (f"world size {world_size} (valid ranks 0.."
+                     f"{world_size - 1})" if world_size is not None
+                     else "any gang")
+            raise ValueError(
+                f"fault spec {part!r}: rank={rank} is out of range for "
+                f"{bound} — this fault could never fire")
         specs.append(FaultSpec(kind, kv["epoch"], kv.get("rank"),
                                kv.get("attempt", 0),
                                kv.get("ms", SLOW_DEFAULT_MS)))
@@ -173,6 +217,10 @@ def _execute(spec: FaultSpec, checkpointer) -> None:
           f"(rank {_me()}, attempt {_attempt()})", file=sys.stderr, flush=True)
     if spec.kind == "crash":
         os._exit(FAULT_CRASH_EXIT)
+    if spec.kind == "vanish":
+        # the exit code IS the "host unreachable" marker: the supervisor
+        # maps it to FailureClass.VANISH and retires this member's host
+        os._exit(FAULT_VANISH_EXIT)
     if spec.kind == "hang":
         while True:          # parked until the watchdog / launch timeout
             time.sleep(3600)

@@ -8,9 +8,11 @@ membership). Here::
 
     python -m harp_tpu.parallel.launch nodes.txt -- python train.py
 
-parses the same file format, assigns process ids in file order, picks the
-first node as the jax.distributed coordinator (the master — Harp: min worker
-id), and launches the command once per node with the gang environment set:
+parses the same file format (plus an optional ``#spare`` section naming the
+supervisor's re-placement pool — see ``parse_nodes_file_with_spares``),
+assigns process ids in file order, picks the first node as the
+jax.distributed coordinator (the master — Harp: min worker id), and launches
+the command once per node with the gang environment set:
 
     HARP_COORDINATOR=<first-host>:<port>  HARP_NUM_PROCESSES=<n>
     HARP_PROCESS_ID=<i>  HARP_RACK=<rack>
@@ -34,6 +36,17 @@ import time
 from typing import List, Optional, Sequence, Tuple
 
 LOCAL_HOSTS = ("localhost", "127.0.0.1", "::1")
+
+# ssh exit code for "could not even reach the host" (transport failure) —
+# distinct from any remote command's own exit. The supervisor uses it, plus a
+# reachability probe, to tell a VANISHED host from a crashed member.
+SSH_TRANSPORT_EXIT = 255
+
+# Bounded connect classification: an unreachable ssh member/spare must be
+# diagnosed in seconds, not at the gang deadline (the reference waited the
+# full 1800 s DATA_MAX_WAIT_TIME, io/Constant.java:36, before concluding
+# "Slaves may fail").
+SSH_CONNECT_TIMEOUT_S = 5
 
 
 @dataclasses.dataclass(frozen=True)
@@ -69,24 +82,80 @@ class GangResult(list):
         return None if self.first_failure is None else self.first_failure[1]
 
 
-def parse_nodes_file(path: str) -> List[Node]:
-    """Parse the reference's nodes format: ``#<rackID>`` headers, one
-    hostname per following line (worker/Nodes.java:37; test fixture
-    core/harp-collective/src/test/resources/test_nodes)."""
-    nodes: List[Node] = []
+def parse_nodes_file_with_spares(path: str) -> Tuple[List[Node], List[Node]]:
+    """Parse the reference's nodes format — ``#<rackID>`` headers, one
+    hostname per following line (worker/Nodes.java:37) — extended with an
+    optional ``#spare`` section: every host after that header is a SPARE,
+    not a gang member. Spares are the supervisor's re-placement pool: a
+    vanished or watchdog-suspect member is swapped for a healthy spare
+    instead of aborting (``RestartPolicy.on_suspect``). ``#<rackID>``
+    headers inside the spare section set spare racks the same way.
+
+    Returns ``(members, spares)``."""
+    members: List[Node] = []
+    spares: List[Node] = []
     rack = 0
+    in_spares = False
     with open(path) as f:
         for raw in f:
             line = raw.strip()
             if not line:
                 continue
+            if line.lower() == "#spare":
+                in_spares = True
+                continue
             if line.startswith("#"):
                 rack = int(line[1:])
                 continue
-            nodes.append(Node(line, rack))
-    if not nodes:
+            (spares if in_spares else members).append(Node(line, rack))
+    if not members:
         raise ValueError(f"no worker hosts in nodes file {path}")
-    return nodes
+    return members, spares
+
+
+def parse_nodes_file(path: str) -> List[Node]:
+    """Gang members of a nodes file (spare section, if any, dropped — use
+    :func:`parse_nodes_file_with_spares` to keep it)."""
+    return parse_nodes_file_with_spares(path)[0]
+
+
+def ssh_options(connect_timeout: float = SSH_CONNECT_TIMEOUT_S) -> List[str]:
+    """The ``-o`` options every gang ssh (member spawn AND reachability
+    probe) runs under: BatchMode so a missing key fails instead of hanging
+    on a password prompt, and a bounded ConnectTimeout with a single
+    connection attempt per exec — an unreachable host is classified in
+    seconds. Exposed (and unit-tested) as a function so the member spawn
+    and the probe can never drift apart."""
+    return ["-o", "BatchMode=yes",
+            "-o", f"ConnectTimeout={max(1, int(connect_timeout))}",
+            "-o", "ConnectionAttempts=1"]
+
+
+def probe_host(host: str, connect_timeout: float = SSH_CONNECT_TIMEOUT_S,
+               attempts: int = 2, runner=None) -> bool:
+    """True iff ``host`` can take a gang member right now. Local hosts are
+    trivially reachable; remote hosts get ``ssh <opts> host true`` with the
+    bounded options above and a bounded retry (``attempts``), so the worst
+    case is ``attempts * (connect_timeout + ~10 s)`` — never the reference's
+    1800 s hang. The supervisor vets every spare through here before a
+    re-placement relaunch, and uses it to confirm a suspected-vanished
+    member's host really is gone. ``runner`` is injectable for tests
+    (defaults to ``subprocess.run``)."""
+    if host in LOCAL_HOSTS:
+        return True
+    runner = runner or subprocess.run
+    for _ in range(max(1, attempts)):
+        try:
+            proc = runner(["ssh", *ssh_options(connect_timeout), host,
+                           "true"],
+                          stdout=subprocess.DEVNULL,
+                          stderr=subprocess.DEVNULL,
+                          timeout=connect_timeout + 10.0)
+        except (subprocess.TimeoutExpired, OSError):
+            continue                  # timeout/exec failure: one retry left
+        if proc.returncode == 0:
+            return True
+    return False
 
 
 def gang_env(nodes: Sequence[Node], process_id: int, port: int) -> dict:
@@ -111,8 +180,7 @@ def _spawn(node: Node, env: dict, command: List[str],
     exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
     remote = (f"cd {shlex.quote(cwd or os.getcwd())} && {exports} "
               + " ".join(shlex.quote(tok) for tok in command))
-    return subprocess.Popen(["ssh", "-tt", "-o", "BatchMode=yes", node.host,
-                             remote],
+    return subprocess.Popen(["ssh", "-tt", *ssh_options(), node.host, remote],
                             stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True)
 

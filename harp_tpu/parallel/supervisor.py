@@ -15,11 +15,28 @@ Policy:
 
 * bounded restart budget (``--max-restarts``), exponential backoff with a cap;
 * per-failure-class handling: repeated watchdog exits (exit 98) from the SAME
-  rank mark that node suspect and abort early — restarting onto a host with a
-  dying accelerator burns the budget without ever finishing;
+  rank mark that node suspect — restarting onto a host with a dying
+  accelerator burns the budget without ever finishing;
+* **elastic re-placement** (``RestartPolicy.on_suspect``): a VANISHED member
+  (scripted ``vanish`` fault exit, or a remote member whose ssh transport
+  died and whose host fails a bounded reachability probe) or a
+  watchdog-suspect node is SWAPPED for a healthy spare from the nodes file's
+  ``#spare`` pool (``on_suspect="replace"``; each spare is vetted with a
+  bounded-ConnectTimeout ssh probe first), or — with no spares left, or
+  ``on_suspect="shrink"`` — the gang relaunches ONE MEMBER SMALLER instead
+  of aborting. Shrink relies on world-size-agnostic checkpoint resume: the
+  training loops re-partition W-worker state onto the W-1 gang
+  (collectives.repartition). ``on_suspect="abort"`` (default) keeps the
+  historical behavior: watchdog suspects abort, vanished hosts just
+  relaunch at the same shape.
+* ``--drop-stragglers`` (``RestartPolicy.drop_stragglers``): when the gang
+  telemetry straggler report attached to a failure names the same rank in
+  ``bsp_suspects`` for ``straggler_strikes`` consecutive failures, that
+  member is dropped through the same replace-else-shrink pipeline — a rank
+  everyone waits on is as fatal to a BSP gang as a dead one.
 * every relaunch appends a JSONL record to the restart journal (attempt,
-  cause, first failing rank, backoff, the step the relaunch resumes from) and
-  bumps counters in ``utils.metrics``.
+  cause, first failing rank, backoff, resumed step, the per-attempt host
+  map and any old→new placement) and bumps counters in ``utils.metrics``.
 
 Each attempt is stamped with ``HARP_GANG_ATTEMPT=<n>`` in the member
 environment, which the deterministic fault layer (``parallel.faults``) keys on
@@ -27,12 +44,7 @@ environment, which the deterministic fault layer (``parallel.faults``) keys on
 and the relaunch runs clean. CLI::
 
     python -m harp_tpu.parallel.supervisor nodes.txt --max-restarts 2 \\
-        --work-dir /tmp/km -- python -m harp_tpu.run kmeans ...
-
-Multi-host note: relaunch is currently local-subprocess only — remote (ssh)
-members are killed fail-stop but a node that VANISHES (ssh unreachable) is
-indistinguishable from a crash; re-placement onto spare hosts is an open item
-(ROADMAP).
+        --on-suspect replace --work-dir /tmp/km -- python -m harp_tpu.run ...
 """
 
 from __future__ import annotations
@@ -54,39 +66,69 @@ from harp_tpu.parallel import launch as launch_mod
 # backend (the children own the accelerator); importing failure just to
 # compare an exit code would wire in both.
 WATCHDOG_EXIT = 98
+# parallel.faults.FAULT_VANISH_EXIT — mirrored for the same reason (faults is
+# jax-free today, but its ckpt-corrupt path reaches into utils.checkpoint;
+# the supervisor compares one integer)
+VANISH_EXIT = 86
 
 
 class FailureClass(enum.Enum):
     CLEAN = "clean"
     CRASH = "crash"          # any unexplained non-zero exit (incl. faults)
     WATCHDOG = "watchdog"    # device heartbeat fail-stop (exit 98)
+    VANISH = "vanish"        # member gone AND its host unreachable (scripted
+    #                          vanish fault, or ssh transport death confirmed
+    #                          by a failed bounded probe): never relaunch
+    #                          onto that host — re-place or shrink
     TIMEOUT = "timeout"      # the whole gang exceeded the launch deadline
 
 
 def classify(result: launch_mod.GangResult
              ) -> Tuple[FailureClass, Optional[int], Optional[int]]:
-    """(class, first failing rank, its exit code) for one gang attempt."""
+    """(class, first failing rank, its exit code) for one gang attempt.
+
+    ``VANISH`` is reported here only for the scripted fault exit; the
+    remote-member flavor (ssh transport exit + host probe failure) needs the
+    host map and is resolved in the supervise loop."""
     if result.ok:
         return FailureClass.CLEAN, None, None
     rank, rc = result.first_failure
-    cls = FailureClass.WATCHDOG if rc == WATCHDOG_EXIT else FailureClass.CRASH
-    return cls, rank, rc
+    if rc == WATCHDOG_EXIT:
+        return FailureClass.WATCHDOG, rank, rc
+    if rc == VANISH_EXIT:
+        return FailureClass.VANISH, rank, rc
+    return FailureClass.CRASH, rank, rc
 
 
 @dataclasses.dataclass(frozen=True)
 class RestartPolicy:
-    """Restart budget + backoff + per-class rules."""
+    """Restart budget + backoff + per-class rules + re-placement policy."""
 
     max_restarts: int = 2
     backoff_base_s: float = 1.0
     backoff_factor: float = 2.0
     backoff_max_s: float = 60.0
     # a rank whose member dies by watchdog this many times is a suspect node
-    # (dying accelerator / wedged driver): abort instead of burning budget
+    # (dying accelerator / wedged driver): stop relaunching onto it
     watchdog_suspect_after: int = 2
     # exit codes that are deterministic, not transient — relaunching cannot
     # help (2 = argparse usage error: bad flags fail identically every time)
     non_retryable_rcs: Tuple[int, ...] = (2,)
+    # what to do with a suspect member (vanished host / repeat-watchdog
+    # node): "replace" swaps in a probed-healthy spare, shrinking instead
+    # when the pool is empty; "shrink" always relaunches one member
+    # smaller; "abort" (default, historical behavior) aborts on a watchdog
+    # suspect and relaunches a vanished member at the same shape
+    on_suspect: str = "abort"
+    # opt-in: drop a member the attached telemetry straggler report names in
+    # bsp_suspects for `straggler_strikes` CONSECUTIVE failures — dropped
+    # through the same replace-else-shrink pipeline regardless of
+    # on_suspect (the flag itself is the opt-in; "abort" still applies to
+    # watchdog suspects)
+    drop_stragglers: bool = False
+    straggler_strikes: int = 2
+    # bounded spare/vanish reachability probing (launch.probe_host)
+    probe_connect_timeout_s: float = float(launch_mod.SSH_CONNECT_TIMEOUT_S)
 
     def backoff(self, restart_index: int) -> float:
         """Backoff before restart #``restart_index`` (0-based), capped."""
@@ -125,9 +167,10 @@ def _straggler_suspects(telemetry_dir: Optional[str]) -> Optional[dict]:
     """The gang telemetry layer's straggler report, if one was published
     (harp_tpu.telemetry.gang; rank 0 writes it next to the per-rank step
     JSONL). The supervisor attaches it to its journal records so an
-    operator — or the future re-placement policy (ROADMAP: drop the suspect
-    and relaunch one member smaller) — sees WHICH rank was dragging the gang
-    at death, not just which rank died. Missing/torn file = no signal."""
+    operator — and the ``drop_stragglers`` re-placement policy, which drops
+    a rank named in consecutive reports — sees WHICH rank was dragging the
+    gang at death, not just which rank died. Missing/torn file = no
+    signal."""
     if not telemetry_dir:
         return None
     from harp_tpu.telemetry.gang import read_straggler_report
@@ -156,6 +199,8 @@ def _resumed_step(checkpoint_dir: Optional[str]) -> Optional[int]:
 
 def supervise(nodes: Sequence[launch_mod.Node], command: List[str], *,
               policy: Optional[RestartPolicy] = None,
+              spares: Sequence[launch_mod.Node] = (),
+              probe: Optional[Callable[[str], bool]] = None,
               timeout: Optional[float] = 1800.0,
               cwd: Optional[str] = None,
               checkpoint_dir: Optional[str] = None,
@@ -167,18 +212,24 @@ def supervise(nodes: Sequence[launch_mod.Node], command: List[str], *,
               echo: bool = False) -> SuperviseOutcome:
     """Run ``command`` as a gang under the elastic restart policy.
 
-    Wraps :func:`launch.launch`; every relaunch reuses the same nodes/command
-    (the checkpointed training loops make the relaunch resume). ``sleep`` is
-    injectable so tests can assert the backoff schedule without waiting it.
+    Wraps :func:`launch.launch`. The supervisor owns a per-attempt host map:
+    by default every relaunch reuses the same nodes/command (the
+    checkpointed training loops make the relaunch resume), but a vanished
+    or suspect member is re-placed onto a ``spares`` host or dropped,
+    depending on ``policy.on_suspect`` — the relaunch then runs at the new
+    shape and the journal records the old→new placement. ``probe`` vets a
+    host's reachability (default: :func:`launch.probe_host` with the
+    policy's bounded ConnectTimeout); injectable so tests can script
+    unreachable spares. ``sleep`` is injectable so tests can assert the
+    backoff schedule without waiting it.
     """
 
-    def attempt_fn(extra_env):
-        return launch_mod.launch(nodes, command, timeout=timeout, cwd=cwd,
-                                 extra_env=extra_env)
+    def attempt_fn(cur_nodes, extra_env):
+        return launch_mod.launch(cur_nodes, command, timeout=timeout,
+                                 cwd=cwd, extra_env=extra_env)
 
-    hosts = [n.host for n in nodes]
-    return _supervise(attempt_fn, hosts, policy=policy,
-                      checkpoint_dir=checkpoint_dir,
+    return _supervise(attempt_fn, nodes, policy=policy, spares=spares,
+                      probe=probe, checkpoint_dir=checkpoint_dir,
                       journal_path=journal_path, metrics=metrics,
                       metrics_path=metrics_path,
                       telemetry_dir=telemetry_dir, sleep=sleep, echo=echo)
@@ -207,7 +258,7 @@ def supervise_local(command: List[str], *,
     import collections
     import threading
 
-    def attempt_fn(extra_env):
+    def attempt_fn(cur_nodes, extra_env):
         proc = subprocess.Popen(
             command, env={**os.environ, **extra_env}, cwd=cwd,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
@@ -240,29 +291,110 @@ def supervise_local(command: List[str], *,
 
     # echo is handled line-by-line above — _supervise must not re-print the
     # buffered output a second time
-    return _supervise(attempt_fn, ["localhost"], policy=policy,
+    return _supervise(attempt_fn, [launch_mod.Node("localhost", 0)],
+                      policy=policy,
                       checkpoint_dir=checkpoint_dir,
                       journal_path=journal_path, metrics=metrics,
                       metrics_path=metrics_path,
                       telemetry_dir=telemetry_dir, sleep=sleep, echo=False)
 
 
-def _supervise(attempt_fn, hosts: List[str], *, policy, checkpoint_dir,
-               journal_path, metrics, metrics_path, sleep, echo,
-               telemetry_dir=None) -> SuperviseOutcome:
+def _pick_suspect(cause, rank, policy, watchdog_deaths, straggler,
+                  straggler_hits, world) -> Optional[Tuple[int, str]]:
+    """(rank, reason) of the member the re-placement policy should act on
+    this attempt, or None. Mutates the per-rank strike counters — ALL of
+    them, on every failure: the straggler reset/strike accounting must run
+    even when the failure classifies as vanish/watchdog, or a rank named
+    in non-consecutive reports would keep stale strikes across the
+    intervening failures (the CONSECUTIVE contract below)."""
+    flagged: Optional[Tuple[int, str]] = None
+    if policy.drop_stragglers:
+        named = (straggler or {}).get("bsp_suspects") or []
+        # sustained = named in CONSECUTIVE failure reports: a rank that
+        # recovers resets its strikes (one slow checkpoint write must not
+        # accumulate into an eviction across a whole day of failures)
+        for r in list(straggler_hits):
+            if r not in named:
+                del straggler_hits[r]
+        for r in named:
+            straggler_hits[r] += 1
+        hit = sorted(r for r, c in straggler_hits.items()
+                     if c >= policy.straggler_strikes and r < world)
+        if hit:
+            flagged = (hit[0], "straggler")
+    # a vanished host / repeat-watchdog node outranks a straggler flag —
+    # the dead member must be handled first; the strikes persist
+    if cause is FailureClass.VANISH and rank is not None:
+        return rank, "vanish"
+    if cause is FailureClass.WATCHDOG and rank is not None:
+        watchdog_deaths[rank] += 1
+        if watchdog_deaths[rank] >= policy.watchdog_suspect_after:
+            return rank, "watchdog"
+    return flagged
+
+
+def _apply_placement(nodes, spares, dead_hosts, probe, suspect, policy,
+                     journal, metrics, attempt) -> Optional[dict]:
+    """Swap the suspect member for a healthy spare, or drop it (shrink).
+    Mutates ``nodes``/``spares``/``dead_hosts``; returns the placement
+    record for the restart journal, or None when a 1-member gang has
+    nothing left to drop."""
+    rank, reason = suspect
+    old = nodes[rank]
+    if policy.on_suspect != "shrink":        # "replace", or a straggler drop
+        while spares:
+            cand = spares.pop(0)
+            if cand.host in dead_hosts:
+                continue
+            if probe(cand.host):
+                nodes[rank] = cand
+                metrics.count("supervisor.replacements")
+                return {"action": "replace", "rank": rank, "reason": reason,
+                        "old_host": old.host, "new_host": cand.host}
+            # an unreachable spare is retired, journaled, and never probed
+            # again (bounded ConnectTimeout — classification in seconds)
+            journal.append({"event": "spare-unreachable", "attempt": attempt,
+                            "host": cand.host})
+            metrics.count("supervisor.spares_unreachable")
+            dead_hosts.add(cand.host)
+    if len(nodes) <= 1:
+        return None
+    del nodes[rank]
+    metrics.count("supervisor.shrinks")
+    return {"action": "shrink", "rank": rank, "reason": reason,
+            "old_host": old.host, "new_host": None}
+
+
+def _supervise(attempt_fn, nodes: Sequence[launch_mod.Node], *, policy,
+               checkpoint_dir, journal_path, metrics, metrics_path, sleep,
+               echo, telemetry_dir=None, spares: Sequence = (),
+               probe=None) -> SuperviseOutcome:
     if metrics is None:
         from harp_tpu.utils.metrics import DEFAULT as metrics
     policy = policy or RestartPolicy()
+    if policy.on_suspect not in ("replace", "shrink", "abort"):
+        raise ValueError(f"on_suspect must be 'replace', 'shrink' or "
+                         f"'abort', got {policy.on_suspect!r}")
     journal = _Journal(journal_path)
+    nodes = list(nodes)                      # the per-attempt host map
+    spares = list(spares)
+    if probe is None:
+        def probe(host):
+            return launch_mod.probe_host(
+                host, connect_timeout=policy.probe_connect_timeout_s)
+    dead_hosts: set = set()                  # vanished/unreachable: retired
     watchdog_deaths: Counter = Counter()
+    straggler_hits: Counter = Counter()
     attempt = 0
     while True:
+        hosts = [n.host for n in nodes]
         extra = {"HARP_GANG_ATTEMPT": str(attempt), "HARP_SUPERVISED": "1"}
         t0 = time.monotonic()
+        attempt_started = time.time()        # wall clock: report_ts domain
         timed_out = False
         results = None
         try:
-            results = attempt_fn(extra)
+            results = attempt_fn(list(nodes), extra)
             cause, rank, rc = classify(results)
         except subprocess.TimeoutExpired as e:
             timed_out = True
@@ -279,10 +411,22 @@ def _supervise(attempt_fn, hosts: List[str], *, policy, checkpoint_dir,
             if attempt > 0:
                 metrics.count("supervisor.recoveries")
             journal.append({"event": "success", "attempt": attempt,
-                            "restarts": attempt, "elapsed_s": elapsed})
+                            "restarts": attempt, "elapsed_s": elapsed,
+                            "hosts": hosts, "world": len(nodes)})
             _finish(metrics, metrics_path)
             return SuperviseOutcome(True, attempt + 1, results,
                                     journal.records)
+        # a remote member whose ssh TRANSPORT died is only vanished if its
+        # host also fails the bounded reachability probe — a remote command
+        # can exit 255 on its own, and an ssh blip is not a dead machine
+        if (cause is FailureClass.CRASH and rank is not None
+                and rc == launch_mod.SSH_TRANSPORT_EXIT
+                and hosts[rank] not in launch_mod.LOCAL_HOSTS
+                and not probe(hosts[rank])):
+            cause = FailureClass.VANISH
+        if cause is FailureClass.VANISH and rank is not None:
+            dead_hosts.add(hosts[rank])
+            metrics.count("supervisor.vanished_members")
         metrics.count("supervisor.failures")
         metrics.count(f"supervisor.failures.{cause.value}")
         # gang-telemetry straggler context (if the dead gang published one):
@@ -295,20 +439,34 @@ def _supervise(attempt_fn, hosts: List[str], *, policy, checkpoint_dir,
             named = straggler["suspects"] or straggler["bsp_suspects"]
             if named:
                 metrics.gauge("supervisor.last_straggler_suspect", named[0])
-        if cause is FailureClass.WATCHDOG and rank is not None:
-            watchdog_deaths[rank] += 1
-            if watchdog_deaths[rank] >= policy.watchdog_suspect_after:
-                journal.append({"event": "abort-suspect", "attempt": attempt,
-                                "cause": cause.value, "first_rank": rank,
-                                "host": hosts[rank],
-                                "watchdog_deaths": watchdog_deaths[rank],
-                                "elapsed_s": elapsed,
-                                "straggler": straggler})
-                metrics.count("supervisor.aborts.suspect_node")
-                _finish(metrics, metrics_path)
-                return SuperviseOutcome(False, attempt + 1, results,
-                                        journal.records,
-                                        gave_up="suspect-node")
+        # strike accounting only trusts a report THIS attempt's gang
+        # published: a stale file from an earlier (possibly re-placed) gang
+        # must not evict a rank on dead evidence. The stale report is still
+        # attached to the journal record as context.
+        straggler_fresh = (straggler if straggler
+                           and (straggler.get("report_ts") or 0)
+                           >= attempt_started else None)
+        suspect = _pick_suspect(cause, rank, policy, watchdog_deaths,
+                                straggler_fresh, straggler_hits, len(nodes))
+        if suspect is not None and suspect[1] == "watchdog" \
+                and policy.on_suspect == "abort":
+            # historical behavior: a repeat-watchdog node aborts the job
+            journal.append({"event": "abort-suspect", "attempt": attempt,
+                            "cause": cause.value, "first_rank": rank,
+                            "host": hosts[rank],
+                            "watchdog_deaths": watchdog_deaths[rank],
+                            "elapsed_s": elapsed,
+                            "straggler": straggler})
+            metrics.count("supervisor.aborts.suspect_node")
+            _finish(metrics, metrics_path)
+            return SuperviseOutcome(False, attempt + 1, results,
+                                    journal.records,
+                                    gave_up="suspect-node")
+        if suspect is not None and suspect[1] == "vanish" \
+                and policy.on_suspect == "abort":
+            # historical behavior: fail-stop + journal, relaunch at the same
+            # shape (the host may come back) — the cause still reads vanish
+            suspect = None
         if rc in policy.non_retryable_rcs:
             journal.append({"event": "abort-non-retryable",
                             "attempt": attempt, "cause": cause.value,
@@ -330,6 +488,28 @@ def _supervise(attempt_fn, hosts: List[str], *, policy, checkpoint_dir,
             _finish(metrics, metrics_path)
             return SuperviseOutcome(False, attempt + 1, results,
                                     journal.records, gave_up="budget")
+        placement = None
+        if suspect is not None:
+            placement = _apply_placement(nodes, spares, dead_hosts, probe,
+                                         suspect, policy, journal, metrics,
+                                         attempt)
+            if placement is None:
+                journal.append({"event": "abort-no-members",
+                                "attempt": attempt, "cause": cause.value,
+                                "first_rank": rank, "host": hosts[rank]
+                                if rank is not None else None,
+                                "elapsed_s": elapsed,
+                                "straggler": straggler})
+                metrics.count("supervisor.aborts.no_members")
+                _finish(metrics, metrics_path)
+                return SuperviseOutcome(False, attempt + 1, results,
+                                        journal.records,
+                                        gave_up="no-members")
+            # the member map changed: per-rank strike counters no longer
+            # describe the same machines (replace) or the same rank
+            # numbering (shrink)
+            watchdog_deaths.clear()
+            straggler_hits.clear()
         backoff = policy.backoff(attempt)
         resumed = _resumed_step(checkpoint_dir)
         journal.append({
@@ -339,14 +519,25 @@ def _supervise(attempt_fn, hosts: List[str], *, policy, checkpoint_dir,
             "backoff_s": backoff, "resumed_step": resumed,
             "elapsed_s": elapsed, "timed_out": timed_out,
             "straggler": straggler,
+            # the placement map: the host every rank relaunches on, plus
+            # the old→new swap (or shrink) this restart performs, if any
+            "hosts": [n.host for n in nodes], "world": len(nodes),
+            "placement": placement,
         })
         metrics.count("supervisor.restarts")
         metrics.count(f"supervisor.restarts.{cause.value}")
         if resumed is not None:
             metrics.gauge("supervisor.last_resumed_step", resumed)
+        note = ""
+        if placement is not None and placement["action"] == "replace":
+            note = (f", re-placing rank {placement['rank']} "
+                    f"{placement['old_host']} -> {placement['new_host']}")
+        elif placement is not None:
+            note = (f", shrinking to {len(nodes)} member(s) (dropped rank "
+                    f"{placement['rank']} on {placement['old_host']})")
         print(f"harp_tpu.supervisor: attempt {attempt} failed "
-              f"({cause.value}, first rank {rank}, rc {rc}) — relaunching "
-              f"in {backoff:.1f}s"
+              f"({cause.value}, first rank {rank}, rc {rc}){note} — "
+              f"relaunching in {backoff:.1f}s"
               + (f" from checkpoint step {resumed}" if resumed is not None
                  else " from scratch (no checkpoint yet)"),
               file=sys.stderr, flush=True)
@@ -399,6 +590,19 @@ def main(argv=None) -> int:
     p.add_argument("--backoff-max", type=float, default=60.0)
     p.add_argument("--timeout", type=float, default=1800.0,
                    help="per-attempt gang deadline, seconds")
+    p.add_argument("--spares", default="",
+                   help="comma-separated spare hosts for re-placement, "
+                        "appended to the nodes file's #spare section")
+    p.add_argument("--on-suspect", default="abort",
+                   choices=["replace", "shrink", "abort"],
+                   help="what to do with a vanished/watchdog-suspect "
+                        "member: swap in a probed-healthy spare (shrinking "
+                        "when the pool is empty), always shrink, or abort "
+                        "(default — the historical behavior)")
+    p.add_argument("--drop-stragglers", action="store_true",
+                   help="drop a member the telemetry straggler report "
+                        "names in bsp_suspects for consecutive failures "
+                        "(replace-else-shrink)")
     p.add_argument("--work-dir", default="",
                    help="the job's work dir: checkpoint dir (work-dir/ckpt) "
                         "for resumed-step journaling, restart journal and "
@@ -415,7 +619,9 @@ def main(argv=None) -> int:
         print("no command given (use -- <command...> or --smoke)",
               file=sys.stderr)
         return 2
-    nodes = launch_mod.parse_nodes_file(args.nodes)
+    nodes, spares = launch_mod.parse_nodes_file_with_spares(args.nodes)
+    spares = spares + [launch_mod.Node(h.strip(), 0)
+                       for h in args.spares.split(",") if h.strip()]
     work = args.work_dir
     journal = args.journal or (os.path.join(work, "restart_journal.jsonl")
                                if work else None)
@@ -423,7 +629,10 @@ def main(argv=None) -> int:
         nodes, command,
         policy=RestartPolicy(max_restarts=args.max_restarts,
                              backoff_base_s=args.backoff_base,
-                             backoff_max_s=args.backoff_max),
+                             backoff_max_s=args.backoff_max,
+                             on_suspect=args.on_suspect,
+                             drop_stragglers=args.drop_stragglers),
+        spares=spares,
         timeout=args.timeout,
         checkpoint_dir=os.path.join(work, "ckpt") if work else None,
         journal_path=journal,

@@ -63,6 +63,31 @@ def _orbax():
 MANIFEST = "manifest.json"
 
 
+def state_meta(state: Dict[str, Any], **extra) -> dict:
+    """Manifest ``meta`` for a dict-of-arrays state: per-leaf shapes/dtypes
+    plus caller fields (``world=``, ``model=``, layout geometry). Written by
+    ``Checkpointer.save(..., meta=...)`` next to the CRCs, so a resume at a
+    DIFFERENT world size can rebuild a restore template matching the SAVED
+    shapes (:func:`meta_like`) before re-partitioning the state
+    (collectives.repartition) onto the new gang."""
+    return {
+        "shapes": {k: [int(d) for d in np.shape(v)] for k, v in state.items()},
+        "dtypes": {k: str(getattr(v, "dtype", np.asarray(v).dtype))
+                   for k, v in state.items()},
+        **extra,
+    }
+
+
+def meta_like(meta: dict) -> Dict[str, np.ndarray]:
+    """A restore template (host zeros) with the SAVED leaves' shapes/dtypes,
+    from a :func:`state_meta` manifest entry — what ``like_from_meta``
+    callbacks hand to ``restore_latest_valid`` when the checkpoint was
+    written at another world size (the current session's shapes would not
+    match the payload)."""
+    return {k: np.zeros(tuple(shape), np.dtype(meta["dtypes"][k]))
+            for k, shape in meta["shapes"].items()}
+
+
 def list_step_numbers(directory: str) -> List[int]:
     """Step numbers under ``directory`` (``step_NNN`` dirs), ascending.
 
@@ -195,8 +220,12 @@ class Checkpointer:
         return list_step_numbers(self.directory)
 
     # -- save / restore ------------------------------------------------------
-    def save(self, step: int, state: Any) -> str:
+    def save(self, step: int, state: Any, meta: Optional[dict] = None) -> str:
         """Save a pytree of arrays; prunes to the newest ``keep`` checkpoints.
+
+        ``meta`` (JSON-serializable, see :func:`state_meta`) rides in the
+        step manifest — models record their world size + layout there so a
+        relaunched gang of a different size can re-partition on resume.
 
         With ``async_save`` the device→host snapshot happens here (consistent
         cut) and the disk write runs on the background thread.
@@ -216,9 +245,10 @@ class Checkpointer:
         state = jax.tree.map(np.asarray, state)    # D2H snapshot
         if self._executor is not None:
             self.wait()                            # one write in flight
-            self._pending = self._executor.submit(self._write, path, state)
+            self._pending = self._executor.submit(self._write, path, state,
+                                                  meta)
         else:
-            self._write(path, state)
+            self._write(path, state, meta)
         return path
 
     def wait(self) -> None:
@@ -227,7 +257,8 @@ class Checkpointer:
             pending, self._pending = self._pending, None
             pending.result()
 
-    def _write(self, path: str, state: Any) -> None:
+    def _write(self, path: str, state: Any,
+               meta: Optional[dict] = None) -> None:
         # Write into a tmp dir and rename: a fail-stop kill mid-write
         # (elastic gang restart, r5) must never leave a step dir that lists
         # as restorable but holds a torn payload — _list_steps only matches
@@ -253,6 +284,8 @@ class Checkpointer:
                                 "dtype": str(np.asarray(leaf).dtype)}
                        for i, leaf in enumerate(leaves)},
         }
+        if meta is not None:
+            manifest["meta"] = meta
         with open(os.path.join(tmp, MANIFEST), "w") as f:
             json.dump(manifest, f)
         if os.path.isdir(path):      # re-save of the same step
@@ -336,15 +369,29 @@ class Checkpointer:
                         "— skipping it for resume", s)
         return None
 
-    def restore_latest_valid(self, like: Optional[Any] = None
-                             ) -> Tuple[Optional[int], Optional[Any]]:
+    def restore_latest_valid(self, like: Optional[Any] = None, *,
+                             like_from_meta=None, return_meta: bool = False
+                             ) -> Tuple:
         """``(step, state)`` of the newest step whose payload verifies,
         reading each candidate payload ONCE — ``latest_valid_step()``
         followed by ``restore()`` reads the newest checkpoint twice (for
         orbax, two full restores), doubling resume I/O in the common
         all-healthy case. Corrupt/torn/unreadable steps are logged and
         skipped for the previous one; manifest-less legacy steps restore
-        untested. ``(None, None)`` when nothing usable exists."""
+        untested. ``(None, None)`` when nothing usable exists.
+
+        ``like_from_meta(meta)`` — when given — builds the restore template
+        PER candidate step from that step's manifest ``meta`` (None for
+        legacy/meta-less steps), overriding ``like``. This is the
+        world-size-agnostic resume hook: a checkpoint written by a W-worker
+        gang holds W-shaped leaves, and the template must match the SAVED
+        shapes (:func:`meta_like`), not the current session's — the model
+        then re-partitions the restored state onto the new world. The
+        per-step resolution matters: after an elastic resize the newest and
+        the fallback step may have been written at DIFFERENT world sizes.
+
+        ``return_meta=True`` appends the restored step's manifest meta:
+        ``(step, state, meta)``."""
         import jax
 
         self.wait()
@@ -356,14 +403,17 @@ class Checkpointer:
                 log.warning("checkpoint step %d has an unreadable manifest "
                             "(%r) — skipping it for resume", s, e)
                 continue
-            if man is not None and like is not None:
+            meta = man.get("meta") if man is not None else None
+            eff_like = like_from_meta(meta) if like_from_meta is not None \
+                else like
+            if man is not None and eff_like is not None:
                 # BEFORE the restore try-block: a structure mismatch must
                 # raise the clear ValueError, not be swallowed as corruption
                 # and silently skipped (which would retrain from scratch)
-                self._require_leaf_count(path, man["leaves"], like)
+                self._require_leaf_count(path, man["leaves"], eff_like)
             try:
                 if self.use_orbax:
-                    state = self.restore(s, like=like)
+                    state = self.restore(s, like=eff_like)
                     leaves = jax.tree.leaves(state)
                 else:
                     with np.load(os.path.join(path, "arrays.npz")) as data:
@@ -381,9 +431,9 @@ class Checkpointer:
             if state is None:
                 # AFTER verification so a structure mismatch raises the
                 # clear ValueError instead of being skipped as corruption
-                state = self._unflatten(path, leaves, like)
-            return s, state
-        return None, None
+                state = self._unflatten(path, leaves, eff_like)
+            return (s, state, meta) if return_meta else (s, state)
+        return (None, None, None) if return_meta else (None, None)
 
     def restore_latest(self, like: Optional[Any] = None) -> Optional[Any]:
         return self.restore_latest_valid(like=like)[1]

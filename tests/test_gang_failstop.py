@@ -89,8 +89,8 @@ def test_gang_elastic_restart_resumes_bitwise(tmp_path):
               "    from harp_tpu.utils import checkpoint as ck\n"
               "    orig = ck.Checkpointer.save\n"
               "    calls = {'n': 0}\n"
-              "    def save_then_die(self, step, state):\n"
-              "        r = orig(self, step, state)\n"
+              "    def save_then_die(self, step, state, **kw):\n"
+              "        r = orig(self, step, state, **kw)\n"
               "        calls['n'] += 1\n"
               "        if calls['n'] == 2:\n"
               "            os._exit(9)\n"
@@ -160,3 +160,20 @@ def test_gang_watchdog_chain_device_hang_fails_the_gang():
 def test_gang_watchdog_env_disable(monkeypatch):
     monkeypatch.setenv("HARP_WATCHDOG", "0")
     assert failure.start_gang_watchdog() is None
+
+
+def test_first_failure_lowest_rank_within_one_poll_interval():
+    """The launch.py:52-57 contract, previously documented but untested:
+    when SEVERAL members die within one poll interval, first_failure blames
+    the LOWEST rank — even if a higher rank died first in wall time. Ranks
+    exit in reverse order (rank 2 first) well inside a single long poll
+    interval, so one sweep observes all three dead and must pick rank 0."""
+    cmd = [sys.executable, "-c",
+           "import os, sys, time\n"
+           "rank = int(os.environ['HARP_PROCESS_ID'])\n"
+           "time.sleep(1.0 - 0.3 * rank)\n"    # rank 2 dies FIRST
+           "sys.exit(10 + rank)"]
+    results = launch.launch(_nodes(3), cmd, timeout=60.0, poll_interval=3.0)
+    assert results.first_failure == (0, 10)
+    # every member's own exit code is still reported faithfully
+    assert [rc for rc, _ in results] == [10, 11, 12]

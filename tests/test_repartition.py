@@ -1,0 +1,346 @@
+"""World-size-agnostic checkpoint resume (collectives.repartition).
+
+The supervisor's shrink-relaunch (parallel.supervisor, on_suspect) restores
+a checkpoint written by W workers into a W' != W gang. These tests pin the
+restore-time resharding contract on the virtual CPU mesh: replicated leaves
+(K-means centroids) transfer exactly; sharded leaves (SGD-MF factor tables,
+the LDA chain) gather-and-resplit through the saved (bin, slot) / token-key
+maps — a pure-resume round trip is EXACT in canonical id order, and a
+resumed-then-continued run converges like an uninterrupted W' run.
+
+All re-partitioning is host-side numpy at restore time: no step program
+changes, so the jaxlint collective budgets (JL201/JL203) are untouched —
+tools/jaxlint's pinned traces are the regression gate for that.
+"""
+
+import numpy as np
+import pytest
+
+from harp_tpu.collectives import repartition as rep
+from harp_tpu.io import datagen
+from harp_tpu.session import HarpSession
+from harp_tpu.utils import checkpoint as ckpt_lib
+from harp_tpu.utils.checkpoint import Checkpointer
+
+
+@pytest.fixture(scope="module")
+def sess8():
+    return HarpSession(num_workers=8)
+
+
+@pytest.fixture(scope="module")
+def sess4():
+    return HarpSession(num_workers=4)
+
+
+# --------------------------------------------------------------------------- #
+# primitives
+# --------------------------------------------------------------------------- #
+
+def test_permute_roundtrip_is_identity(rng):
+    from harp_tpu.models.sgd_mf import serpentine_assign
+
+    n, bins, rpb = 37, 4, 10
+    counts = rng.integers(1, 50, n)
+    assign = serpentine_assign(counts, bins)
+    canon = rng.standard_normal((n, 3)).astype(np.float32)
+    fill = np.full((bins * rpb, 3), np.nan, np.float32)
+    permuted = rep.permute_rows(canon, assign[0], assign[1], rpb, fill)
+    back = rep.unpermute_rows(permuted, assign[0], assign[1], rpb, n)
+    np.testing.assert_array_equal(back, canon)
+
+
+def test_repartition_factor_across_bin_counts(rng):
+    from harp_tpu.models.sgd_mf import identity_assign, serpentine_assign
+
+    n = 29
+    counts = rng.integers(1, 9, n)
+    old_assign, old_rpb = serpentine_assign(counts, 8), 4
+    new_assign, new_rpb = identity_assign(n, 4), 8
+    canon = rng.standard_normal((n, 2)).astype(np.float32)
+    saved = rep.permute_rows(canon, old_assign[0], old_assign[1], old_rpb,
+                             np.zeros((8 * old_rpb, 2), np.float32))
+    moved = rep.repartition_factor(saved, old_assign, old_rpb, new_assign,
+                                   new_rpb, n,
+                                   np.zeros((4 * new_rpb, 2), np.float32))
+    back = rep.unpermute_rows(moved, new_assign[0], new_assign[1], new_rpb, n)
+    np.testing.assert_array_equal(back, canon)
+
+
+def test_rematch_tokens_matches_by_doc_vocab(rng):
+    docs = np.array([0, 0, 0, 1, 1])
+    vocab = np.array([5, 5, 2, 2, 7])
+    payload = np.array([10, 11, 12, 13, 14])
+    order = rng.permutation(5)
+    out = rep.rematch_tokens(docs, vocab, payload, docs[order], vocab[order])
+    # same-(doc, vocab) duplicates may swap (exchangeable) — here all keys
+    # with duplicates carry distinct payloads only within (0, 5)
+    assert sorted(out.tolist()) == sorted(payload.tolist())
+    for d, v in {(0, 2), (1, 2), (1, 7)}:
+        mask_new = (docs[order] == d) & (vocab[order] == v)
+        mask_old = (docs == d) & (vocab == v)
+        assert set(out[mask_new]) == set(payload[mask_old])
+
+
+def test_rematch_tokens_rejects_foreign_corpus():
+    with pytest.raises(ValueError, match="different data"):
+        rep.rematch_tokens(np.array([0]), np.array([1]), np.array([9]),
+                           np.array([0]), np.array([2]))
+
+
+# --------------------------------------------------------------------------- #
+# checkpoint meta plumbing
+# --------------------------------------------------------------------------- #
+
+def test_state_meta_roundtrips_through_manifest(tmp_path):
+    state = {"a": np.ones((3, 2), np.float32), "b": np.zeros(5, np.int32)}
+    meta = ckpt_lib.state_meta(state, model="demo", world=8)
+    ck = Checkpointer(str(tmp_path), use_orbax=False)
+    ck.save(1, state, meta=meta)
+    step, restored, got = ck.restore_latest_valid(
+        like={k: np.zeros_like(v) for k, v in state.items()},
+        return_meta=True)
+    assert step == 1 and got["world"] == 8 and got["model"] == "demo"
+    like = ckpt_lib.meta_like(got)
+    assert like["a"].shape == (3, 2) and like["b"].dtype == np.int32
+    np.testing.assert_array_equal(restored["a"], state["a"])
+
+
+def test_like_from_meta_resolves_per_step(tmp_path):
+    # steps written at DIFFERENT world sizes: the template must follow each
+    # candidate step's own meta (a corrupt newest step falls back to a step
+    # of another shape)
+    from harp_tpu.parallel import faults
+
+    ck = Checkpointer(str(tmp_path), use_orbax=False, keep=5)
+    s1 = {"w": np.full((8, 2), 1.0, np.float32)}
+    s2 = {"w": np.full((4, 2), 2.0, np.float32)}
+    ck.save(1, s1, meta=ckpt_lib.state_meta(s1, world=8))
+    ck.save(2, s2, meta=ckpt_lib.state_meta(s2, world=4))
+    faults.corrupt_latest(str(tmp_path))
+    step, state, meta = ck.restore_latest_valid(
+        like_from_meta=lambda m: ckpt_lib.meta_like(m), return_meta=True)
+    assert step == 1 and meta["world"] == 8
+    assert np.shape(state["w"]) == (8, 2)
+
+
+# --------------------------------------------------------------------------- #
+# kmeans: replicated leaves restore exactly across world sizes
+# --------------------------------------------------------------------------- #
+
+def test_kmeans_w8_checkpoint_resumes_into_w4(tmp_path, sess8, sess4):
+    from harp_tpu.models import kmeans as km
+
+    pts = datagen.dense_points(256, 8, seed=0, num_clusters=4)
+    cen0 = datagen.initial_centroids(pts, 4, seed=1)
+    cfg = km.KMeansConfig(4, 8, iterations=6)
+
+    m8 = km.KMeans(sess8, cfg)
+    ck = Checkpointer(str(tmp_path / "ck"))
+    m8.fit_checkpointed(*m8.prepare(pts, cen0), ck, save_every=1,
+                        iterations=3)
+
+    # shrink resume: W=8 checkpoint at iteration 3 finishes under W=4
+    m4 = km.KMeans(sess4, cfg)
+    ck_b = Checkpointer(str(tmp_path / "ck"))
+    cen_res, costs_res, start = m4.fit_checkpointed(
+        *m4.prepare(pts, cen0), ck_b, save_every=1)
+    assert start == 3 and len(costs_res) == 3
+
+    # convergence parity vs an uninterrupted W=4 run: Lloyd only reorders
+    # the allreduce sum across worker counts, so the trajectories agree to
+    # float tolerance
+    m4c = km.KMeans(sess4, cfg)
+    ck_c = Checkpointer(str(tmp_path / "clean"))
+    cen_clean, costs_clean, _ = m4c.fit_checkpointed(
+        *m4c.prepare(pts, cen0), ck_c, save_every=1)
+    np.testing.assert_allclose(np.asarray(cen_res), np.asarray(cen_clean),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(costs_res[-1], costs_clean[-1], rtol=1e-4)
+
+
+# --------------------------------------------------------------------------- #
+# sgd_mf: sharded factors gather-and-resplit through the saved id maps
+# --------------------------------------------------------------------------- #
+
+def _ratings():
+    return datagen.sparse_ratings(64, 64, rank=4, density=0.25, seed=3)
+
+
+def test_sgd_mf_w8_state_restores_exactly_into_w4(tmp_path, sess8, sess4):
+    # pure resume (no further epochs): the canonical (id-ordered) factors a
+    # W=4 resume finalizes must be BITWISE the ones W=8 checkpointed. Note
+    # 64 rows block to 8x8 AND 4x16 — the factor shapes collide across
+    # worlds, so only the manifest world metadata can route this correctly.
+    from harp_tpu.models import sgd_mf
+
+    rows, cols, vals = _ratings()
+    cfg = sgd_mf.SGDMFConfig(rank=4, epochs=2, layout="sparse",
+                             minibatches_per_hop=2)
+    m8 = sgd_mf.SGDMF(sess8, cfg)
+    st8 = m8.prepare(rows, cols, vals, 64, 64, seed=0)
+    ck = Checkpointer(str(tmp_path / "ck"))
+    w_a, h_a, rmse_a, start_a = m8.fit_checkpointed(st8, ck, save_every=1)
+    assert start_a == 0 and len(rmse_a) == 2
+
+    m4 = sgd_mf.SGDMF(sess4, cfg)
+    st4 = m4.prepare(rows, cols, vals, 64, 64, seed=0)
+    ck_b = Checkpointer(str(tmp_path / "ck"))
+    w_b, h_b, rmse_b, start_b = m4.fit_checkpointed(st4, ck_b, save_every=1)
+    assert start_b == 2 and len(rmse_b) == 0
+    np.testing.assert_array_equal(w_b, w_a)
+    np.testing.assert_array_equal(h_b, h_a)
+
+
+def test_sgd_mf_w8_checkpoint_continues_converging_at_w4(tmp_path, sess8,
+                                                         sess4):
+    from harp_tpu.models import sgd_mf
+
+    rows, cols, vals = _ratings()
+    cfg = sgd_mf.SGDMFConfig(rank=4, epochs=6, layout="sparse",
+                             minibatches_per_hop=2)
+    m8 = sgd_mf.SGDMF(sess8, cfg)
+    st8 = m8.prepare(rows, cols, vals, 64, 64, seed=0)
+    ck = Checkpointer(str(tmp_path / "ck"))
+    m8.fit_checkpointed(st8, ck, epochs=2, save_every=1)
+
+    m4 = sgd_mf.SGDMF(sess4, cfg)
+    st4 = m4.prepare(rows, cols, vals, 64, 64, seed=0)
+    ck_b = Checkpointer(str(tmp_path / "ck"))
+    _, _, rmse_res, start = m4.fit_checkpointed(st4, ck_b, save_every=1)
+    assert start == 2 and len(rmse_res) == 4
+    assert rmse_res[-1] <= rmse_res[0] + 1e-6     # still descending at W=4
+
+    m4c = sgd_mf.SGDMF(sess4, cfg)
+    st4c = m4c.prepare(rows, cols, vals, 64, 64, seed=0)
+    ck_c = Checkpointer(str(tmp_path / "clean"))
+    _, _, rmse_clean, _ = m4c.fit_checkpointed(st4c, ck_c, save_every=1)
+    # convergence parity: the shrink-resumed run lands where a clean W=4
+    # run lands (trajectories differ — different blocking — but quality
+    # must not)
+    assert abs(float(rmse_res[-1]) - float(rmse_clean[-1])) < 0.05, \
+        (rmse_res, rmse_clean)
+
+
+# --------------------------------------------------------------------------- #
+# lda: chain state re-matches tokens by (doc, vocab) key
+# --------------------------------------------------------------------------- #
+
+def test_lda_w8_chain_restores_exactly_into_w4(tmp_path, sess8, sess4):
+    from harp_tpu.models import lda
+
+    docs = datagen.lda_corpus(16, 32, 4, 12, seed=5)
+    cfg = lda.LDAConfig(num_topics=4, vocab=32, epochs=2)
+    m8 = lda.LDA(sess8, cfg)
+    ck = Checkpointer(str(tmp_path / "ck"))
+    dt_a, wt_a, ll_a, _ = m8.fit_checkpointed(m8.prepare(docs, seed=0), ck,
+                                              save_every=1)
+
+    m4 = lda.LDA(sess4, cfg)
+    ck_b = Checkpointer(str(tmp_path / "ck"))
+    dt_b, wt_b, ll_b, start = m4.fit_checkpointed(m4.prepare(docs, seed=0),
+                                                  ck_b, save_every=1)
+    assert start == 2 and len(ll_b) == 0
+    # doc-topic and word-topic COUNTS are invariant under the only freedom
+    # the re-match has (same-word-same-doc occurrence order) — exact
+    np.testing.assert_array_equal(np.asarray(dt_b), np.asarray(dt_a))
+    np.testing.assert_array_equal(np.asarray(wt_b), np.asarray(wt_a))
+
+
+def test_lda_w8_checkpoint_continues_at_w4(tmp_path, sess8, sess4):
+    from harp_tpu.models import lda
+
+    docs = datagen.lda_corpus(16, 32, 4, 12, seed=5)
+    cfg = lda.LDAConfig(num_topics=4, vocab=32, epochs=4)
+    m8 = lda.LDA(sess8, cfg)
+    ck = Checkpointer(str(tmp_path / "ck"))
+    m8.fit_checkpointed(m8.prepare(docs, seed=0), ck, save_every=1, epochs=2)
+
+    m4 = lda.LDA(sess4, cfg)
+    ck_b = Checkpointer(str(tmp_path / "ck"))
+    dt, wt, ll, start = m4.fit_checkpointed(m4.prepare(docs, seed=0), ck_b,
+                                            save_every=1)
+    assert start == 2 and len(ll) == 2
+    assert np.all(np.isfinite(ll))
+    assert dt.shape == (16, 4) and wt.shape == (32, 4)
+    # the restored chain must carry exactly the corpus's token mass
+    np.testing.assert_allclose(np.asarray(wt).sum(), docs.size, rtol=1e-6)
+
+
+def test_sgd_mf_legacy_metaless_checkpoint_still_resumes(tmp_path, sess8):
+    # a pre-elastic checkpoint holds only {w, h} and no manifest meta: the
+    # SAME-world resume must keep working (restored through the legacy
+    # template), not die on a leaf-count mismatch against the new 6-leaf
+    # state
+    from harp_tpu.models import sgd_mf
+
+    rows, cols, vals = _ratings()
+    cfg = sgd_mf.SGDMFConfig(rank=4, epochs=2, layout="sparse",
+                             minibatches_per_hop=2)
+    m = sgd_mf.SGDMF(sess8, cfg)
+    st = m.prepare(rows, cols, vals, 64, 64, seed=0)
+    ck = Checkpointer(str(tmp_path / "full"), use_orbax=False)
+    w_a, h_a, _, _ = m.fit_checkpointed(st, ck, save_every=1)
+    _, saved, _ = ck.restore_latest_valid(
+        like_from_meta=lambda meta: ckpt_lib.meta_like(meta),
+        return_meta=True)
+
+    legacy = Checkpointer(str(tmp_path / "legacy"), use_orbax=False)
+    legacy.save(2, {"w": saved["w"], "h": saved["h"]})      # no meta
+    m2 = sgd_mf.SGDMF(sess8, cfg)
+    st2 = m2.prepare(rows, cols, vals, 64, 64, seed=0)
+    w_b, h_b, rmse_b, start = m2.fit_checkpointed(
+        st2, Checkpointer(str(tmp_path / "legacy"), use_orbax=False),
+        save_every=1)
+    assert start == 2 and len(rmse_b) == 0
+    np.testing.assert_array_equal(w_b, w_a)
+    np.testing.assert_array_equal(h_b, h_a)
+
+
+def test_lda_legacy_metaless_checkpoint_still_resumes(tmp_path, sess8):
+    from harp_tpu.models import lda
+
+    docs = datagen.lda_corpus(16, 32, 4, 12, seed=5)
+    cfg = lda.LDAConfig(num_topics=4, vocab=32, epochs=2)
+    m = lda.LDA(sess8, cfg)
+    ck = Checkpointer(str(tmp_path / "full"), use_orbax=False)
+    dt_a, wt_a, _, _ = m.fit_checkpointed(m.prepare(docs, seed=0), ck,
+                                          save_every=1)
+    _, saved, _ = ck.restore_latest_valid(
+        like_from_meta=lambda meta: ckpt_lib.meta_like(meta),
+        return_meta=True)
+
+    legacy = Checkpointer(str(tmp_path / "legacy"), use_orbax=False)
+    legacy.save(2, {"z": saved["z"], "wt": saved["wt"]})    # no meta
+    m2 = lda.LDA(sess8, cfg)
+    dt_b, wt_b, ll_b, start = m2.fit_checkpointed(
+        m2.prepare(docs, seed=0),
+        Checkpointer(str(tmp_path / "legacy"), use_orbax=False),
+        save_every=1)
+    assert start == 2 and len(ll_b) == 0
+    np.testing.assert_array_equal(np.asarray(dt_b), np.asarray(dt_a))
+    np.testing.assert_array_equal(np.asarray(wt_b), np.asarray(wt_a))
+
+
+def test_wrong_model_work_dir_raises_clearly(tmp_path, sess8):
+    # the restore template follows the SAVED shapes, so the old leaf-count
+    # guard can't catch a wrong-model dir anymore — the recorded model name
+    # must (an LDA resume pointed at an sgd_mf work dir used to die with a
+    # raw KeyError)
+    from harp_tpu.models import lda, sgd_mf
+
+    rows, cols, vals = _ratings()
+    cfg_mf = sgd_mf.SGDMFConfig(rank=4, epochs=1, layout="sparse",
+                                minibatches_per_hop=2)
+    m = sgd_mf.SGDMF(sess8, cfg_mf)
+    st = m.prepare(rows, cols, vals, 64, 64, seed=0)
+    ck = Checkpointer(str(tmp_path / "ck"), use_orbax=False)
+    m.fit_checkpointed(st, ck, save_every=1)
+
+    docs = datagen.lda_corpus(16, 32, 4, 12, seed=5)
+    m_lda = lda.LDA(sess8, lda.LDAConfig(num_topics=4, vocab=32, epochs=2))
+    with pytest.raises(ValueError, match="written by model 'sgd_mf'"):
+        m_lda.fit_checkpointed(
+            m_lda.prepare(docs, seed=0),
+            Checkpointer(str(tmp_path / "ck"), use_orbax=False),
+            save_every=1)

@@ -1,0 +1,155 @@
+"""End-to-end elastic re-placement: scripted vanish -> spare swap or shrink.
+
+The mp_smoke-style acceptance legs for ISSUE 8: a local gang training K-means
+under the supervisor loses a member to a scripted ``vanish`` fault
+(parallel.faults — the member exits and its host is treated as unreachable),
+and the supervisor either re-places it onto a ``#spare``-pool host (same
+world size -> the resumed run is BITWISE the clean run, extending PR 1's
+kill-relaunch-resume contract across a host swap) or, with no spares left,
+relaunches the gang one member smaller (world-size-agnostic checkpoint
+resume) and still converges.
+"""
+
+import json
+import os
+import re
+import sys
+
+import pytest
+
+from harp_tpu.parallel import faults, launch, supervisor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _nodes(n):
+    return [launch.Node("localhost", 0) for _ in range(n)]
+
+
+def _journal(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+def _km_cmd(work):
+    # each member holds 2 virtual devices; 512 points divide over every
+    # world size this file relaunches at (8, 4, 2 devices)
+    return [sys.executable, "-m", "harp_tpu.run", "kmeans", "--cpu-mesh",
+            "--num-workers", "2", "--num-points", "512",
+            "--num-centroids", "4", "--dim", "8", "--iterations", "6",
+            "--work-dir", str(work), "--save-every", "1"]
+
+
+def _with_fault(spec):
+    class _Env:
+        def __enter__(self):
+            self.backup = os.environ.get("HARP_FAULT")
+            os.environ["HARP_FAULT"] = spec
+            return self
+
+        def __exit__(self, *exc):
+            if self.backup is None:
+                os.environ.pop("HARP_FAULT", None)
+            else:
+                os.environ["HARP_FAULT"] = self.backup
+    return _Env()
+
+
+def test_gang_vanish_replaced_on_spare_resumes_bitwise(tmp_path):
+    """vanish@rank=1 with a spare in the pool: the supervisor swaps the
+    vanished member for the spare, the relaunch resumes from the newest
+    VERIFIED checkpoint at the SAME world size, and the final model is
+    bitwise the clean run's — the PR 1 kill-relaunch-resume contract, now
+    across a host swap."""
+    ref_work = tmp_path / "ref"
+    results = launch.launch(_nodes(2), _km_cmd(ref_work), timeout=420.0,
+                            cwd=REPO)
+    assert results.ok, list(results)
+
+    work = tmp_path / "faulted"
+    with _with_fault("vanish@epoch=3:rank=1"):
+        out = supervisor.supervise(
+            _nodes(2), _km_cmd(work),
+            policy=supervisor.RestartPolicy(max_restarts=2,
+                                            on_suspect="replace"),
+            spares=[launch.Node("127.0.0.1", 0)],
+            timeout=420.0, cwd=REPO,
+            checkpoint_dir=str(work / "ckpt"),
+            journal_path=str(work / "restart_journal.jsonl"))
+    assert out.ok and out.attempts == 2
+    assert (work / "centroids.csv").read_bytes() == \
+        (ref_work / "centroids.csv").read_bytes()
+    restarts = [r for r in _journal(work / "restart_journal.jsonl")
+                if r["event"] == "restart"]
+    assert len(restarts) == 1
+    r = restarts[0]
+    assert r["cause"] == "vanish"
+    assert r["first_rank"] == 1 and r["first_rc"] == faults.FAULT_VANISH_EXIT
+    assert r["resumed_step"] == 2            # vanish fired BEFORE epoch 3 ran
+    assert r["placement"] == {"action": "replace", "rank": 1,
+                              "reason": "vanish", "old_host": "localhost",
+                              "new_host": "127.0.0.1"}
+    assert r["hosts"] == ["localhost", "127.0.0.1"] and r["world"] == 2
+    assert "straggler" in r                  # the PR 7 report rides along
+
+
+def test_gang_vanish_no_spares_shrinks_and_converges(tmp_path):
+    """Zero spares: the vanished member is dropped and the gang relaunches
+    one smaller. K-means resumes the W-written checkpoint into the smaller
+    mesh (replicated centroids — exact) and converges."""
+    work = tmp_path / "shrink"
+    with _with_fault("vanish@epoch=3:rank=0"):
+        out = supervisor.supervise(
+            _nodes(2), _km_cmd(work),
+            policy=supervisor.RestartPolicy(max_restarts=2,
+                                            on_suspect="replace"),
+            timeout=420.0, cwd=REPO,
+            checkpoint_dir=str(work / "ckpt"),
+            journal_path=str(work / "restart_journal.jsonl"))
+    assert out.ok and out.attempts == 2
+    restarts = [r for r in _journal(work / "restart_journal.jsonl")
+                if r["event"] == "restart"]
+    assert len(restarts) == 1
+    r = restarts[0]
+    assert r["cause"] == "vanish" and r["resumed_step"] == 2
+    assert r["placement"]["action"] == "shrink"
+    assert r["world"] == 1 and r["hosts"] == ["localhost"]
+    assert (work / "centroids.csv").exists()
+    # convergence: the resumed (smaller) gang's cost kept descending
+    text = "".join(outp for _, outp in out.results)
+    m = re.search(r"cost ([\d.eE+-]+) -> ([\d.eE+-]+)", text)
+    assert m, text
+    assert float(m.group(2)) <= float(m.group(1))
+
+
+@pytest.mark.slow
+def test_gang_acceptance_4_members_1_spare_vanish_rank2(tmp_path):
+    """The full ISSUE 8 acceptance scenario: gang of 4 + 1 spare, scripted
+    vanish@epoch=2:rank=2 -> the supervisor relaunches with the spare, the
+    journal records the placement swap + straggler report, and the resumed
+    run's result is bitwise-equal to an uninterrupted run."""
+    ref_work = tmp_path / "ref"
+    assert launch.launch(_nodes(4), _km_cmd(ref_work), timeout=600.0,
+                         cwd=REPO).ok
+
+    work = tmp_path / "faulted"
+    with _with_fault("vanish@epoch=2:rank=2"):
+        out = supervisor.supervise(
+            _nodes(4), _km_cmd(work),
+            policy=supervisor.RestartPolicy(max_restarts=2,
+                                            on_suspect="replace"),
+            spares=[launch.Node("127.0.0.1", 0)],
+            timeout=600.0, cwd=REPO,
+            checkpoint_dir=str(work / "ckpt"),
+            journal_path=str(work / "restart_journal.jsonl"))
+    assert out.ok and out.attempts == 2
+    assert (work / "centroids.csv").read_bytes() == \
+        (ref_work / "centroids.csv").read_bytes()
+    r = next(rec for rec in _journal(work / "restart_journal.jsonl")
+             if rec["event"] == "restart")
+    assert r["placement"] == {"action": "replace", "rank": 2,
+                              "reason": "vanish", "old_host": "localhost",
+                              "new_host": "127.0.0.1"}
+    assert r["hosts"] == ["localhost", "localhost", "127.0.0.1",
+                          "localhost"]
+    assert r["resumed_step"] == 1 and "straggler" in r

@@ -546,3 +546,335 @@ def test_gang_supervisor_corrupt_checkpoint_resume(tmp_path):
                 if r["event"] == "restart"]
     assert len(restarts) == 1
     assert restarts[0]["resumed_step"] == 1   # step 2 was corrupt
+
+
+# --------------------------------------------------------------------------- #
+# elastic re-placement: spare pools, vanish classification, shrink-relaunch
+# --------------------------------------------------------------------------- #
+
+def _vanish_cmd(rank="1", then="sys.exit(0)"):
+    """Gang member: scripted vanish of `rank` on attempt 0, `then` after."""
+    return [sys.executable, "-c",
+            "import os, sys\n"
+            "if os.environ['HARP_GANG_ATTEMPT'] == '0' and "
+            f"os.environ['HARP_PROCESS_ID'] == '{rank}':\n"
+            "    sys.exit(86)\n"
+            + then]
+
+
+def test_parse_nodes_file_spare_section(tmp_path):
+    nodes_file = tmp_path / "nodes"
+    nodes_file.write_text("#0\nhostA\nhostB\n#spare\nspare1\n#1\nspare2\n")
+    members, spares = launch.parse_nodes_file_with_spares(str(nodes_file))
+    assert [n.host for n in members] == ["hostA", "hostB"]
+    assert [(n.host, n.rack) for n in spares] == [("spare1", 0),
+                                                 ("spare2", 1)]
+    # the members-only parser stays back-compatible
+    assert launch.parse_nodes_file(str(nodes_file)) == members
+
+
+def test_ssh_option_construction():
+    opts = launch.ssh_options(connect_timeout=7)
+    assert opts == ["-o", "BatchMode=yes", "-o", "ConnectTimeout=7",
+                    "-o", "ConnectionAttempts=1"]
+    # sub-second timeouts still produce a valid (>= 1 s) ssh option
+    assert "ConnectTimeout=1" in launch.ssh_options(connect_timeout=0.2)
+
+
+def test_remote_spawn_uses_bounded_ssh_options(monkeypatch):
+    captured = {}
+
+    def fake_popen(argv, **kwargs):
+        captured["argv"] = argv
+
+        class P:
+            stdout = None
+        return P()
+
+    monkeypatch.setattr(launch.subprocess, "Popen", fake_popen)
+    launch._spawn(launch.Node("far-host", 0), {"HARP_PROCESS_ID": "0"},
+                  ["echo", "hi"])
+    argv = captured["argv"]
+    assert argv[:2] == ["ssh", "-tt"]
+    assert argv[2:8] == launch.ssh_options()
+    assert argv[8] == "far-host"
+
+
+def test_probe_host_bounded_retry():
+    calls = []
+
+    def runner(argv, **kwargs):
+        calls.append(argv)
+
+        class P:
+            returncode = 255
+        return P()
+
+    assert launch.probe_host("localhost") is True         # no ssh at all
+    assert launch.probe_host("far-host", connect_timeout=1, attempts=2,
+                             runner=runner) is False
+    assert len(calls) == 2                                # bounded retry
+    assert all("ConnectTimeout=1" in " ".join(a) for a in calls)
+
+    def runner_ok(argv, **kwargs):
+        class P:
+            returncode = 0
+        return P()
+
+    assert launch.probe_host("far-host", runner=runner_ok) is True
+
+
+def test_fault_vanish_kind_parses_and_fires(tmp_path):
+    specs = faults.parse_faults("vanish@epoch=2:rank=1", world_size=4)
+    assert specs == [faults.FaultSpec("vanish", 2, 1, 0)]
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "from harp_tpu.parallel import faults\n"
+         "for epoch in range(1, 4):\n"
+         "    faults.fire(epoch)\n"],
+        env={**os.environ, "HARP_FAULT": "vanish@epoch=2:rank=0"},
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == faults.FAULT_VANISH_EXIT
+
+
+def test_fault_rank_out_of_range_rejected_loudly():
+    with pytest.raises(ValueError, match=r"rank=5 is out of range for "
+                                         r"world size 4 \(valid ranks "
+                                         r"0\.\.3\)"):
+        faults.parse_faults("crash@epoch=1:rank=5", world_size=4)
+    with pytest.raises(ValueError, match="rank=-1"):
+        faults.parse_faults("crash@epoch=1:rank=-1")
+    # world size flows in from the gang env too (fires on every boundary)
+    env_backup = dict(os.environ)
+    os.environ["HARP_NUM_PROCESSES"] = "2"
+    os.environ["HARP_FAULT"] = "crash@epoch=1:rank=3"
+    try:
+        with pytest.raises(ValueError, match="out of range"):
+            faults.fire(1)
+    finally:
+        os.environ.clear()
+        os.environ.update(env_backup)
+
+
+def test_supervise_vanish_replaces_with_spare(tmp_path):
+    journal_path = str(tmp_path / "journal.jsonl")
+    out = supervisor.supervise(
+        _nodes(2), _vanish_cmd(),
+        policy=supervisor.RestartPolicy(max_restarts=2, on_suspect="replace"),
+        spares=[launch.Node("127.0.0.1", 0)],
+        timeout=60.0, journal_path=journal_path, sleep=lambda s: None)
+    assert out.ok and out.attempts == 2
+    restarts = [r for r in _journal(journal_path) if r["event"] == "restart"]
+    assert len(restarts) == 1
+    r = restarts[0]
+    assert r["cause"] == "vanish" and r["first_rc"] == 86
+    # the placement-map schema the journal contract pins
+    assert r["placement"] == {"action": "replace", "rank": 1,
+                              "reason": "vanish", "old_host": "localhost",
+                              "new_host": "127.0.0.1"}
+    assert r["hosts"] == ["localhost", "127.0.0.1"] and r["world"] == 2
+
+
+def test_supervise_unreachable_spare_falls_back_to_shrink(tmp_path):
+    journal_path = str(tmp_path / "journal.jsonl")
+    out = supervisor.supervise(
+        _nodes(2), _vanish_cmd(),
+        policy=supervisor.RestartPolicy(max_restarts=2, on_suspect="replace"),
+        spares=[launch.Node("dead-spare", 0)],
+        probe=lambda host: host != "dead-spare",
+        timeout=60.0, journal_path=journal_path, sleep=lambda s: None)
+    assert out.ok and out.attempts == 2
+    records = _journal(journal_path)
+    assert [r["event"] for r in records] == ["spare-unreachable", "restart",
+                                            "success"]
+    assert records[0]["host"] == "dead-spare"
+    r = records[1]
+    assert r["placement"]["action"] == "shrink"
+    assert r["placement"]["rank"] == 1 and r["world"] == 1
+
+
+def test_supervise_shrink_relaunches_one_smaller(tmp_path):
+    # the relaunched gang must really be one member smaller: the surviving
+    # member asserts HARP_NUM_PROCESSES shrank from 3 to 2
+    cmd = [sys.executable, "-c",
+           "import os, sys, time\n"
+           "if os.environ['HARP_GANG_ATTEMPT'] == '0':\n"
+           "    if os.environ['HARP_PROCESS_ID'] == '2':\n"
+           "        sys.exit(86)\n"
+           "    time.sleep(120)\n"      # survivors: killed by fail-stop
+           "sys.exit(0 if os.environ['HARP_NUM_PROCESSES'] == '2' else 17)"]
+    out = supervisor.supervise(
+        _nodes(3), cmd,
+        policy=supervisor.RestartPolicy(max_restarts=2, on_suspect="shrink"),
+        timeout=60.0, sleep=lambda s: None)
+    assert out.ok and out.attempts == 2
+    restart = next(r for r in out.journal if r["event"] == "restart")
+    assert restart["placement"]["action"] == "shrink"
+    assert restart["world"] == 2 and len(restart["hosts"]) == 2
+
+
+def test_supervise_vanish_with_abort_policy_keeps_shape(tmp_path):
+    # default-compatible: on_suspect="abort" relaunches a vanished member at
+    # the SAME shape (fail-stop + journal, the PR 1 behavior) — the cause
+    # still reads vanish so operators see what happened
+    out = supervisor.supervise(
+        _nodes(2), _vanish_cmd(),
+        policy=supervisor.RestartPolicy(max_restarts=2),
+        timeout=60.0, sleep=lambda s: None)
+    assert out.ok and out.attempts == 2
+    restart = next(r for r in out.journal if r["event"] == "restart")
+    assert restart["cause"] == "vanish"
+    assert restart["placement"] is None and restart["world"] == 2
+
+
+def test_supervise_watchdog_suspect_replaced_not_aborted(tmp_path):
+    # rank 1 watchdog-dies on attempts 0 and 1 (suspect after 2); with a
+    # spare pool the supervisor swaps the node instead of aborting; the
+    # member only survives once re-placed (attempt 2)
+    cmd = [sys.executable, "-c",
+           "import os, sys\n"
+           "if os.environ['HARP_PROCESS_ID'] == '1' and "
+           "int(os.environ['HARP_GANG_ATTEMPT']) < 2:\n"
+           "    sys.exit(98)\n"
+           "sys.exit(0)"]
+    out = supervisor.supervise(
+        _nodes(2), cmd,
+        policy=supervisor.RestartPolicy(max_restarts=3, on_suspect="replace",
+                                        watchdog_suspect_after=2),
+        spares=[launch.Node("127.0.0.1", 0)],
+        timeout=60.0, sleep=lambda s: None)
+    assert out.ok and out.attempts == 3
+    placements = [r["placement"] for r in out.journal
+                  if r["event"] == "restart" and r["placement"]]
+    assert len(placements) == 1
+    assert placements[0]["action"] == "replace"
+    assert placements[0]["reason"] == "watchdog"
+
+
+def test_supervise_drop_stragglers_on_sustained_bsp_suspect(tmp_path):
+    # the gang keeps crashing while the telemetry straggler report names
+    # rank 1 in bsp_suspects: after straggler_strikes consecutive failures
+    # the member is dropped (no spares -> shrink), and the next attempt
+    # succeeds
+    tele = tmp_path / "tele"
+    tele.mkdir()
+    (tele / "straggler_report.json").write_text(json.dumps(
+        {"suspects": [], "bsp_suspects": [1], "gang_median_p50_s": 0.5,
+         "num_ranks": 2, "ts": time.time() + 1e6}))   # stays fresh per attempt
+    cmd = [sys.executable, "-c",
+           "import os, sys\n"
+           "sys.exit(7 if os.environ['HARP_NUM_PROCESSES'] == '2' else 0)"]
+    out = supervisor.supervise(
+        _nodes(2), cmd,
+        policy=supervisor.RestartPolicy(max_restarts=4,
+                                        drop_stragglers=True,
+                                        straggler_strikes=2),
+        telemetry_dir=str(tele),
+        timeout=60.0, journal_path=str(tmp_path / "j.jsonl"),
+        sleep=lambda s: None)
+    assert out.ok and out.attempts == 3
+    placements = [r["placement"] for r in out.journal
+                  if r["event"] == "restart" and r.get("placement")]
+    assert len(placements) == 1
+    assert placements[0] == {"action": "shrink", "rank": 1,
+                             "reason": "straggler", "old_host": "localhost",
+                             "new_host": None}
+
+
+def test_supervise_single_member_cannot_shrink(tmp_path):
+    cmd = [sys.executable, "-c", "import sys; sys.exit(86)"]
+    out = supervisor.supervise(
+        _nodes(1), cmd,
+        policy=supervisor.RestartPolicy(max_restarts=3, on_suspect="shrink"),
+        timeout=60.0, sleep=lambda s: None)
+    assert not out.ok and out.gave_up == "no-members"
+    assert out.journal[-1]["event"] == "abort-no-members"
+
+
+def test_supervise_rejects_unknown_on_suspect():
+    with pytest.raises(ValueError, match="on_suspect"):
+        supervisor.supervise(
+            _nodes(1), [sys.executable, "-c", "pass"],
+            policy=supervisor.RestartPolicy(on_suspect="bogus"),
+            timeout=10.0, sleep=lambda s: None)
+
+
+def test_supervise_stale_straggler_report_never_evicts(tmp_path):
+    # a report published BEFORE this attempt started (ts in the past) is
+    # attached to the journal as context but earns no eviction strikes — a
+    # dead gang's evidence must not drop a member of the relaunched one
+    tele = tmp_path / "tele"
+    tele.mkdir()
+    (tele / "straggler_report.json").write_text(json.dumps(
+        {"suspects": [], "bsp_suspects": [1], "gang_median_p50_s": 0.5,
+         "num_ranks": 2, "ts": 0.0}))
+    out = supervisor.supervise(
+        _nodes(2), [sys.executable, "-c", "import sys; sys.exit(7)"],
+        policy=supervisor.RestartPolicy(max_restarts=2,
+                                        drop_stragglers=True,
+                                        straggler_strikes=2),
+        telemetry_dir=str(tele),
+        timeout=60.0, sleep=lambda s: None)
+    assert not out.ok and out.gave_up == "budget"
+    restarts = [r for r in out.journal if r["event"] == "restart"]
+    assert all(r["placement"] is None for r in restarts)       # no eviction
+    assert restarts[0]["straggler"]["bsp_suspects"] == [1]     # but journaled
+
+
+def test_fault_rank_validation_exempts_disarmed_specs():
+    # after a shrink-relaunch the spec that vanished the old top rank is
+    # still in the environment: on attempt 1 of the now-1-member gang it is
+    # DISARMED (attempt gating), so the range check must not brick the
+    # relaunch — while a spec armed for THIS attempt still fails loudly
+    env_backup = dict(os.environ)
+    os.environ.update({"HARP_NUM_PROCESSES": "1", "HARP_GANG_ATTEMPT": "1",
+                       "HARP_FAULT": "vanish@epoch=3:rank=1"})
+    try:
+        faults.fire(3)                       # disarmed: parses, never fires
+        os.environ["HARP_FAULT"] = "vanish@epoch=3:rank=1:attempt=1"
+        with pytest.raises(ValueError, match="out of range"):
+            faults.fire(3)
+    finally:
+        os.environ.clear()
+        os.environ.update(env_backup)
+
+
+def test_straggler_strikes_reset_across_intervening_watchdog(tmp_path):
+    # the CONSECUTIVE contract survives a vanish/watchdog failure in the
+    # middle: attempt 0 names rank 1 (strike 1), attempt 1 is a watchdog
+    # death with NO fresh report naming it — the strike must reset, so the
+    # budget runs out with rank 1 never evicted
+    import time as _time
+
+    tele = tmp_path / "tele"
+    tele.mkdir()
+    report = {"suspects": [], "bsp_suspects": [1], "gang_median_p50_s": 0.5,
+              "num_ranks": 2, "ts": _time.time() + 1e6}
+    (tele / "straggler_report.json").write_text(json.dumps(report))
+    attempts = {"n": -1}
+
+    def attempt_and_flip_report(*a, **k):
+        attempts["n"] += 1
+        if attempts["n"] == 1:
+            # intervening watchdog death; report no longer names rank 1
+            (tele / "straggler_report.json").write_text(json.dumps(
+                {**report, "bsp_suspects": []}))
+            return launch.GangResult([(98, ""), (0, "")],
+                                     first_failure=(0, 98))
+        (tele / "straggler_report.json").write_text(json.dumps(
+            {**report, "bsp_suspects": [1]}))
+        return launch.GangResult([(7, ""), (0, "")], first_failure=(0, 7))
+
+    out = supervisor._supervise(
+        attempt_and_flip_report, _nodes(2),
+        policy=supervisor.RestartPolicy(max_restarts=3,
+                                        drop_stragglers=True,
+                                        straggler_strikes=2,
+                                        watchdog_suspect_after=5),
+        checkpoint_dir=None, journal_path=None, metrics=None,
+        metrics_path=None, sleep=lambda s: None, echo=False,
+        telemetry_dir=str(tele))
+    assert not out.ok and out.gave_up == "budget"
+    restarts = [r for r in out.journal if r["event"] == "restart"]
+    # named on attempts 0 and 2 but NOT consecutively: never dropped
+    assert all(r["placement"] is None for r in restarts), restarts
