@@ -257,7 +257,7 @@ def cpu_sgd_mf_samples_per_sec(nu, ni, epochs):
 # ALS (BASELINE configs[2] names daal_als alongside SGD-MF — implicit, CSR)
 # --------------------------------------------------------------------------- #
 
-def tpu_als(nu, ni, iters):
+def tpu_als(nu, ni, iters, ablate_solve=False):
     from harp_tpu.io import datagen
     from harp_tpu.models import als
     from harp_tpu.session import HarpSession
@@ -270,7 +270,7 @@ def tpu_als(nu, ni, iters):
 
     def build(ni_):
         cfg = als.ALSConfig(rank=32, lam=0.1, alpha=40.0, iterations=ni_,
-                            implicit=True)
+                            implicit=True, ablate_solve=ablate_solve)
         model = als.ALS(sess, cfg)
         state = model.prepare(rows, cols, vals, nu, ni, seed=0)
         _, _, rmse = model.train_prepared(state)          # compile + warm-up
@@ -286,6 +286,28 @@ def tpu_als(nu, ni, iters):
     tp["final_rmse"] = round(meta[iters], 4)
     tp["layout"] = meta["layout"]
     return tp
+
+
+def tpu_als_stage(nu, ni, iters, full_row=None):
+    """ALS per-iteration stage budget by solve ablation (ISSUE 9 satellite:
+    the thinnest north-star margin, lb 5.22, gets a MEASURED stage row —
+    the r3/r4 PERF one-off ablation as a reproducible bench sub-row).
+    ``ablate_solve=True`` rides identity through the batched k×k SPD solve
+    (results wrong, timing only), so full − ablated prices the solve and
+    the remainder is gram/gather/allgather + bookkeeping."""
+    full = full_row if full_row is not None else tpu_als(nu, ni, iters)
+    ablated = tpu_als(nu, ni, iters, ablate_solve=True)
+    f_ms, a_ms = full["per_iter_ms"], ablated["per_iter_ms"]
+    return {
+        "config": f"nu={nu} ni={ni} rank=32 implicit two-point",
+        "full_ms_per_iter": f_ms,
+        "solve_ablated_ms_per_iter": a_ms,
+        "solve_ms_per_iter": round(f_ms - a_ms, 3),
+        "solve_share_pct": round(100.0 * max(f_ms - a_ms, 0.0)
+                                 / max(f_ms, 1e-9), 1),
+        "note": ("solve-ablated results are wrong by construction "
+                 "(ALSConfig.ablate_solve); this row prices stages only"),
+    }
 
 
 def cpu_als_iters_per_sec(nu, ni, iters):
@@ -899,6 +921,38 @@ def tpu_telemetry_overhead(small=False):
             "telemetry_dir": tele_dir}
 
 
+def tpu_ring_dma_overlap(small=False):
+    """Fused ring-DMA overlap ablation (ISSUE 9 acceptance): hidden comm
+    time on two ring workloads — the LDA wt-block rotation
+    (benchmark/lda_overlap, fused twins) and ring attention
+    (benchmark/ring_overlap). Each row carries unfused / rotation-ablated /
+    fused timings plus ``fused_hidden_fraction`` = the share of the
+    measured hop cost the in-kernel ``make_async_remote_copy`` transport
+    hides. Returns None on a CPU-only host (null-with-note convention; the
+    driver's on-chip run fills it — the fused kernels only exist on TPU,
+    the CPU fallback is transport-identical to ppermute by design)."""
+    import jax
+
+    if all(d.platform == "cpu" for d in jax.devices()):
+        return None
+    from harp_tpu.benchmark import lda_overlap, ring_overlap
+    from harp_tpu.session import HarpSession
+
+    workers = HarpSession().num_workers
+    row = {
+        "lda_rotation": lda_overlap.measure(epochs=4 if small else 8,
+                                            reps=3, fused=True),
+        "ring_attention": ring_overlap.measure(
+            l_local=2048 if small else 8192, reps=3),
+    }
+    if workers < 2:
+        row["note"] = (
+            f"single-device mesh (workers={workers}): ring hops are "
+            f"self-loops, so the ablation degenerates — a >=2-chip run is "
+            f"needed for a meaningful overlap fraction")
+    return row
+
+
 def p2p_event_rtt_us(rounds=200):
     """Host event-plane round trip (send → wait_event → reply → wait): the
     latency the true P2P transport (authenticated, loopback) delivers.
@@ -976,7 +1030,8 @@ ROW_GROUPS = ("kmeans", "kmeans_padded128", "kmeans_csr", "sgd_mf", "als",
               "pca", "lda", "lda_large", "lda_clueweb_subblock", "nn",
               "nn_compute_bound", "attention", "attention_blocksparse",
               "kernel_svm", "mds", "sort", "csr_cov", "kmeans_from_files",
-              "p2p", "mesh", "collectives_quantized", "telemetry_overhead")
+              "p2p", "mesh", "collectives_quantized", "telemetry_overhead",
+              "ring_dma_overlap")
 
 
 def main():
@@ -1107,11 +1162,18 @@ def main():
         an = 2048 if small else 8192
         als = tpu_als(an, an, iters=6 if small else 120)
         als_cpu = cpu_als_iters_per_sec(an, an, iters=1)
+        # r10: the measured stage budget (solve share by ablation) rides
+        # the als group — the thinnest north-star margin gets a row, not
+        # an assertion
+        als_stages = tpu_als_stage(an, an, iters=6 if small else 120,
+                                   full_row=als)
         detail.update({
-            "als": als, "als_cpu_anchor_iters_per_sec": round(als_cpu, 4)})
+            "als": als, "als_cpu_anchor_iters_per_sec": round(als_cpu, 4),
+            "als_stage_budget": als_stages})
         compact.update({
             "als_iters_per_sec": round(als["rate"], 2),
-            "als_vs_xeon36_lb": xeon_lb(als["rate"] / als_cpu)})
+            "als_vs_xeon36_lb": xeon_lb(als["rate"] / als_cpu),
+            "als_solve_share_pct": als_stages["solve_share_pct"]})
 
     if want("pca"):
         begin("pca")
@@ -1337,6 +1399,38 @@ def main():
         elif isinstance(trow, dict) and "overhead_pct" in trow:
             compact["telemetry_overhead_pct"] = trow["overhead_pct"]
             compact["telemetry_overhead_pass"] = trow["pass"]
+
+    if want("ring_dma_overlap"):
+        begin("ring_dma_overlap")
+        try:
+            rrow = tpu_ring_dma_overlap(small)
+        except Exception as e:     # noqa: BLE001 — bench must not die here
+            rrow = {"error": str(e)[:200]}
+        detail["ring_dma_overlap"] = rrow
+        if rrow is None:
+            detail["bench_schema_note_r10"] = (
+                "r10 adds the ring_dma_overlap group (bench.py --only "
+                "ring_dma_overlap): the fused ring-DMA overlap ablation on "
+                "two ring workloads — LDA wt-block rotation "
+                "(benchmark/lda_overlap fused twins) and ring attention "
+                "(benchmark/ring_overlap) — each row carrying unfused / "
+                "rotation-ablated / fused timings and "
+                "fused_hidden_fraction. Committed null because no TPU was "
+                "reachable from this session (CPU-only devices; the fused "
+                "make_async_remote_copy kernels only lower on TPU, and "
+                "the CPU fallback is transport-identical to ppermute by "
+                "design so its delta is dispatch noise). The driver's "
+                "on-chip run fills it; fused == unfused bitwise parity "
+                "and the row schema ARE asserted in tier-1 "
+                "(tests/test_ring_dma.py). The als group also gains "
+                "als_stage_budget (solve share by ALSConfig.ablate_solve "
+                "ablation) — measured whenever the als group runs; null "
+                "for the same no-TPU reason until the driver's run.")
+        elif isinstance(rrow, dict) and "ring_attention" in rrow:
+            compact["ring_dma_lda_hidden_fraction"] = (
+                rrow["lda_rotation"].get("fused_hidden_fraction"))
+            compact["ring_dma_attn_hidden_fraction"] = (
+                rrow["ring_attention"].get("fused_hidden_fraction"))
 
     detail["xeon_anchor_note"] = (
         f"vs_cpu = measured vs ONE modern Zen core (this host has 1 "

@@ -14,6 +14,17 @@ asserting it. Three timings of the same corpus/epoch budget:
     reference's schedule: half-width blocks, one in flight while the other
     is sampled)
 
+r10 adds the fused ring-DMA twins (``fused=True``, the default):
+
+  * ``fused_single`` / ``fused_two_slice`` — the same two schedules with
+    ``LDAConfig(fused_dma=True)``: wt-block hops ride the in-kernel
+    ``make_async_remote_copy`` engine (ops/ring_dma) instead of ppermute.
+    ``(single - fused_single) / (single - no_rot)`` is the fraction of the
+    measured hop cost the fused transport hides — the ISSUE 9 overlap
+    ablation. Off TPU the engine lowers to the tagged lax fallback, so the
+    CPU-mesh fused deltas measure dispatch structure only; the on-chip
+    driver run is the real ablation (bench.py --only ring_dma_overlap).
+
 Run on the virtual 8-device CPU mesh (host collectives price higher relative
 to compute than ICI would, so the measured rotation share is an UPPER bound
 for real multi-chip TPU)::
@@ -32,7 +43,7 @@ import time
 
 
 def measure(num_docs=256, vocab=4096, num_topics=32, doc_len=64, epochs=8,
-            reps=3) -> dict:
+            reps=3, fused=True) -> dict:
     import numpy as np
 
     from harp_tpu.io import datagen
@@ -60,7 +71,7 @@ def measure(num_docs=256, vocab=4096, num_topics=32, doc_len=64, epochs=8,
     t_norot = time_variant(num_model_slices=1, ablate_rotation=True)
     t_two = time_variant(num_model_slices=2)
     rot_share = max(0.0, (t_single - t_norot) / t_single)
-    return {
+    row = {
         "workers": sess.num_workers,
         "tokens": int(docs.size),
         "epochs": epochs,
@@ -72,6 +83,20 @@ def measure(num_docs=256, vocab=4096, num_topics=32, doc_len=64, epochs=8,
         "rotation_share": round(rot_share, 4),
         "two_slice_speedup": round(t_single / t_two, 4),
     }
+    if fused:
+        t_fused = time_variant(num_model_slices=1, fused_dma=True)
+        t_fused_two = time_variant(num_model_slices=2, fused_dma=True)
+        hop_cost = max(t_single - t_norot, 1e-12)
+        row.update({
+            "fused_single_s": round(t_fused, 4),
+            "fused_two_slice_s": round(t_fused_two, 4),
+            "fused_speedup": round(t_single / t_fused, 4),
+            # fraction of the measured hop cost the fused transport hides
+            # (clipped: CPU-mesh noise can push the delta past the hop)
+            "fused_hidden_fraction": round(
+                min(1.0, max(0.0, (t_single - t_fused) / hop_cost)), 4),
+        })
+    return row
 
 
 def main() -> None:

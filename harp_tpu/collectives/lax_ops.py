@@ -95,15 +95,28 @@ def broadcast(x: jax.Array, root: int = 0, axis_name: str = WORKERS) -> jax.Arra
 
 
 def allgather(x: jax.Array, axis_name: str = WORKERS, tiled: bool = True,
-              comm: Optional[quantize.CommConfig] = None) -> jax.Array:
+              comm: Optional[quantize.CommConfig] = None,
+              fused: bool = False) -> jax.Array:
     """Concatenate every worker's block along axis 0 (ring allgather).
 
     Reference: AllgatherCollective.allgather:147 (send-to-next ring relay).
     ``comm``: opt-in quantized wire format (stateless — every worker decodes
     the same payload, so the gathered result stays replicated-consistent).
-    """
+
+    ``fused`` (r10): run the reference's ring relay LITERALLY as W−1 fused
+    in-kernel DMA hops (ops/ring_dma.ring_allgather — bitwise
+    ``all_gather``, no per-hop staging copies; off TPU the engine's tagged
+    fallback keeps the jaxpr budget honest). A quantized wire takes
+    precedence (the codec needs its encode/decode programs around the
+    transport)."""
     if comm is not None and comm.active:
         return quantize.allgather_q(x, axis_name, comm, tiled=tiled)
+    if fused:
+        from harp_tpu.ops import ring_dma  # local: ring_dma imports lax_ops
+
+        if tiled:
+            return ring_dma.ring_allgather(x, axis_name)
+        return ring_dma.ring_allgather(x[None], axis_name)
     return jax.lax.all_gather(x, axis_name, tiled=tiled)
 
 

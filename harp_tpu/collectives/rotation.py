@@ -32,6 +32,20 @@ Wire-format options (this layer owns the hot hops, so both live here):
   ~``DCN_CHUNK_BYTES`` ppermute chunks so in-flight pieces pipeline over the
   slow link; an ICI hop stays one monolithic permute (the extra dispatches
   would only cost latency on a fabric that is already one hop wide).
+* ``fused_dma`` (r10, ops/ring_dma.py): float-leaf payloads ride the fused
+  in-kernel ``make_async_remote_copy`` hop instead of ``ppermute`` — on TPU
+  the block moves producer-HBM → remote-HBM with no staging copies; off TPU
+  the engine's tagged lax fallback keeps the schedule bitwise-identical and
+  the jaxpr budget books the bytes as ``fused_dma``. Precedence: a
+  quantized hop (``comm`` active) keeps the quantize path (the wire is
+  already 2-4× smaller and needs its encode/decode programs), and a DCN
+  hop keeps the chunked ppermute pipeline — ``fused_dma`` engages only on
+  plain ICI hops, where it is exact.
+* ``ef_state`` (r10): pass a residual tree (:func:`ef_zero`) to carry the
+  quantization error-feedback state ACROSS calls — e.g. LDA threads the
+  wt-block residual through its epoch scan so an epoch boundary never
+  drops the pending error; the call then returns the updated state as an
+  extra output.
 """
 
 from __future__ import annotations
@@ -42,6 +56,7 @@ import jax
 import jax.numpy as jnp
 
 from harp_tpu.collectives import lax_ops, quantize
+from harp_tpu.ops import ring_dma
 from harp_tpu.parallel import mesh as mesh_lib
 from harp_tpu.parallel.mesh import WORKERS
 
@@ -83,13 +98,22 @@ def _ef_zero(block: Slice):
         else jnp.zeros((), jnp.float32), block)
 
 
+# public alias: models that thread EF state through their own scan carries
+# (``ef_state=``) build the initial residual with this
+ef_zero = _ef_zero
+
+
 def _shift_block(block: Slice, res: Optional[Slice], shift: int,
                  axis_name: str, comm: Optional[quantize.CommConfig],
-                 link_class: str):
+                 link_class: str, fused: bool = False):
     """One hop of the block pytree: quantized+EF when ``comm`` is active,
-    chunked when the link class asks for it. Returns (block', res')."""
+    chunked when the link class asks for it, fused ring DMA for float
+    leaves when ``fused`` (plain ICI hops only — the caller resolves the
+    precedence). Returns (block', res')."""
     if comm is None or not comm.active:
         def send(x):
+            if fused and _quantizable(x):
+                return ring_dma.hop(x, shift, axis_name)
             return lax_ops.rotate(
                 x, shift, axis_name,
                 num_chunks=chunks_for_link(_leaf_bytes(x), link_class))
@@ -130,7 +154,9 @@ def rotate_scan(
     shift: int = 1,
     comm: Optional[quantize.CommConfig] = None,
     link_class: Optional[str] = None,
-) -> Tuple[Carry, Slice]:
+    fused_dma: bool = False,
+    ef_state: Optional[Slice] = None,
+):
     """Unpipelined rotation loop: compute on the block, then shift it.
 
     ``body(carry, block, step) -> (carry, updated_block)``. After ``num_steps`` =
@@ -146,20 +172,29 @@ def rotate_scan(
     residual rides in the scan carry; with ``comm`` active the returned
     block is the lossy-wire trajectory (convergence-equivalent, not
     bit-identical — models pin a parity tolerance vs the f32 run).
+
+    ``fused_dma``/``ef_state``: module docstring. With ``ef_state`` passed
+    the return is ``(carry, block, ef_state')``; otherwise the historical
+    2-tuple.
     """
     link = _resolve_link(link_class, axis_name)
     quant = comm is not None and comm.active
-    res0 = _ef_zero(model_block) if quant else None
+    fused = fused_dma and not quant and link == "ici"
+    res0 = (ef_state if ef_state is not None
+            else _ef_zero(model_block) if quant else None)
 
     def step(state, t):
         c, blk, res = state
         c, blk = body(c, blk, t)
         if shift:
-            blk, res = _shift_block(blk, res, shift, axis_name, comm, link)
+            blk, res = _shift_block(blk, res, shift, axis_name, comm, link,
+                                    fused=fused)
         return (c, blk, res), None
 
-    (carry, model_block, _), _ = jax.lax.scan(
+    (carry, model_block, res), _ = jax.lax.scan(
         step, (carry, model_block, res0), jnp.arange(num_steps))
+    if ef_state is not None:
+        return carry, model_block, res
     return carry, model_block
 
 
@@ -173,7 +208,9 @@ def pipelined_rotation(
     shift: int = 1,
     comm: Optional[quantize.CommConfig] = None,
     link_class: Optional[str] = None,
-) -> Tuple[Carry, Slice, Slice]:
+    fused_dma: bool = False,
+    ef_state: Optional[Tuple[Slice, Slice]] = None,
+):
     """Double-buffered rotation: compute on one slice while the other is in flight.
 
     The model is split into two slices (Harp: numModelSlices=2). Micro-step t:
@@ -197,11 +234,19 @@ def pipelined_rotation(
     the slices do — slice A's encode error is re-sent with the next
     A-family send, never injected into B's coordinates (and slices of
     different shapes each keep a correctly-shaped residual).
+
+    ``fused_dma``/``ef_state``: module docstring. ``ef_state`` is the
+    ``(residual_a, residual_b)`` pair; when passed the return is
+    ``(carry, slice_a', slice_b', ef_state')``.
     """
     link = _resolve_link(link_class, axis_name)
     quant = comm is not None and comm.active
-    res_a0 = _ef_zero(slice_a) if quant else None
-    res_b0 = _ef_zero(slice_b) if quant else None
+    fused = fused_dma and not quant and link == "ici"
+    if ef_state is not None:
+        res_a0, res_b0 = ef_state
+    else:
+        res_a0 = _ef_zero(slice_a) if quant else None
+        res_b0 = _ef_zero(slice_b) if quant else None
 
     def step(state, t):
         c, resident, inflight, res_res, res_inf = state
@@ -209,15 +254,18 @@ def pipelined_rotation(
         outgoing = updated
         if shift:
             outgoing, res_res = _shift_block(updated, res_res, shift,
-                                             axis_name, comm, link)
+                                             axis_name, comm, link,
+                                             fused=fused)
         # inflight was issued last step; it is resident for the next step. XLA sees
         # `outgoing` unused until step t+1 → overlaps the permute with t+1's compute.
         # The residuals swap seats in lockstep with their slices.
         return (c, inflight, outgoing, res_inf, res_res), None
 
     state = (carry, slice_a, slice_b, res_a0, res_b0)
-    (carry, sa, sb, _, _), _ = jax.lax.scan(step, state,
-                                            jnp.arange(num_micro_steps))
+    (carry, sa, sb, res_a, res_b), _ = jax.lax.scan(
+        step, state, jnp.arange(num_micro_steps))
+    if ef_state is not None:
+        return carry, sa, sb, (res_a, res_b)
     return carry, sa, sb
 
 
@@ -234,7 +282,9 @@ class Rotator:
     def __init__(self, num_workers: int, num_slices: int = 2,
                  axis_name: str = WORKERS,
                  comm: Optional[quantize.CommConfig] = None,
-                 link_class: Optional[str] = None):
+                 link_class: Optional[str] = None,
+                 fused_dma: bool = False,
+                 shift: int = 1):
         if num_slices not in (1, 2):
             raise ValueError("num_slices must be 1 (plain) or 2 (double-buffered)")
         self.num_workers = num_workers
@@ -242,6 +292,11 @@ class Rotator:
         self.axis_name = axis_name
         self.comm = comm
         self.link_class = link_class
+        self.fused_dma = fused_dma
+        # shift=0: the scan never permutes — either a timing ablation
+        # (rotate_scan doc) or a body that performs the hop ITSELF (the
+        # dense-MF in-kernel ring epilogue returns the already-hopped block)
+        self.shift = shift
 
     def run(self, body, carry, slices, epochs: int = 1):
         """Run ``epochs`` full rotations. ``slices``: tuple of model slices
@@ -250,11 +305,13 @@ class Rotator:
             (slice_a,) = slices
             carry, out = rotate_scan(body, carry, slice_a,
                                      epochs * self.num_workers, self.axis_name,
-                                     comm=self.comm,
-                                     link_class=self.link_class)
+                                     shift=self.shift, comm=self.comm,
+                                     link_class=self.link_class,
+                                     fused_dma=self.fused_dma)
             return carry, (out,)
         sa, sb = slices
         carry, sa, sb = pipelined_rotation(
             body, carry, sa, sb, epochs * 2 * self.num_workers, self.axis_name,
-            comm=self.comm, link_class=self.link_class)
+            shift=self.shift, comm=self.comm, link_class=self.link_class,
+            fused_dma=self.fused_dma)
         return carry, (sa, sb)

@@ -106,15 +106,19 @@ def allgather(
     partitioner: Optional[partitioner_lib.Partitioner] = None,
     axis_name: str = WORKERS,
     comm=None,
+    fused: bool = False,
 ) -> Table:
     """SHARDED → REPLICATED (AllgatherCollective.allgather:147, ring relay).
 
     ``partitioner`` must match the one used at regroup time so partition-ID order is
     restored after the gather. ``comm``: opt-in quantized wire format
     (stateless — the gathered result stays replicated-consistent).
+    ``fused`` (r10): the reference's ring relay as W−1 fused in-kernel DMA
+    hops (ops/ring_dma; bitwise ``all_gather``) — the Table-level face of
+    the shared ring engine.
     """
     _expect(t, Dist.SHARDED, "allgather")
-    full = lax_ops.allgather(t.data, axis_name, comm=comm)
+    full = lax_ops.allgather(t.data, axis_name, comm=comm, fused=fused)
     inv = partitioner.inverse_permutation() if partitioner is not None else None
     full = _perm_apply(full, inv)
     return t.with_data(full, Dist.REPLICATED)
@@ -178,11 +182,14 @@ def pull(
     partitioner: Optional[partitioner_lib.Partitioner] = None,
     axis_name: str = WORKERS,
     comm=None,
+    fused: bool = False,
 ) -> Table:
     """Parameter-server pull: SHARDED global → REPLICATED local copy
     (LocalGlobalSyncCollective.pull:185; the chain-bcast variant :228-295 is an XLA
-    scheduling detail here). ``comm``: quantized wire format for the gather."""
-    return allgather(global_table, partitioner, axis_name, comm=comm)
+    scheduling detail here). ``comm``: quantized wire format for the gather;
+    ``fused``: the r10 ring-DMA relay (see :func:`allgather`)."""
+    return allgather(global_table, partitioner, axis_name, comm=comm,
+                     fused=fused)
 
 
 def gather(t: Table, root: int = 0, axis_name: str = WORKERS) -> Table:

@@ -143,7 +143,22 @@ class LDAConfig:
     #   totals — convergence-equivalent, not bit-identical (the parity test
     #   uses the deterministic CVB0 method so the comparison is pure
     #   quantization error, not CGS chain divergence). The circulating
-    #   word-topic block stays exact: its counts ARE the model.
+    #   word-topic block stays exact: its counts ARE the model — unless
+    #   quant_wt opts it in too (below).
+    quant_wt: bool = False      # r10 (requires quant): ALSO quantize the
+    #   circulating word-topic BLOCK rotation payload — the (vpb, K) hop
+    #   that is LDA's dominant wire volume (the topic-total allreduce quant
+    #   above moves only K floats/hop). int8/bf16 per the quant codec, with
+    #   the error-feedback residual threaded through the EPOCH carry
+    #   (rotation.rotate_scan/pipelined_rotation ``ef_state``), so an epoch
+    #   boundary never drops the pending encode error. Counts become
+    #   fractional on the wire (EF keeps the time-average exact) — the
+    #   parity test again uses CVB0 so the delta is pure wire error.
+    fused_dma: bool = False     # r10: the wt-block rotation hops ride the
+    #   fused ring-DMA engine (ops/ring_dma) instead of ppermute — on TPU
+    #   the block moves HBM → remote HBM in-kernel with no staging copies;
+    #   bitwise-identical schedule on every backend. A quantized wt wire
+    #   (quant_wt) takes precedence over fusion (rotation.py module doc).
 
 
 def bucketize_tokens(docs: np.ndarray, num_blocks: int, vpb: int,
@@ -230,6 +245,10 @@ class LDA:
             raise ValueError(
                 "wt_access='gemm_scatter' requires method='cgs' (CVB0's "
                 "soft deltas are not bf16-exact)")
+        if config.quant_wt and config.quant is None:
+            raise ValueError(
+                "quant_wt=True requires quant='int8'|'bf16' (it selects "
+                "WHICH payloads ride the quantized wire, not the codec)")
         if config.vocab_sub_block:
             if config.vocab_sub_block < 1:
                 raise ValueError(
@@ -508,7 +527,16 @@ class LDA:
                 return (ll_w - jnp.sum(lgamma(topic_tot + v_beta))
                         + k * lgamma(v_beta))
 
+            # quant_wt: the wt-block hop rides the quantized wire; its EF
+            # residual lives in the EPOCH carry (ef_state threading) so the
+            # pending encode error survives epoch boundaries
+            quant_wt = comm is not None and cfg.quant_wt
+            wt_comm = comm if quant_wt else None
+
             def epoch(state, _):
+                if quant_wt:
+                    *core, wt_res = state
+                    state = tuple(core)
                 if comm is None:
                     doc_topic, z, topic_tot, wt, key = state
                     hop_carry = (doc_topic, z, topic_tot, key)
@@ -516,14 +544,27 @@ class LDA:
                     doc_topic, z, topic_tot, wt, key, qres = state
                     hop_carry = (doc_topic, z, topic_tot, key, qres)
                 if ns == 1:
-                    hop_carry, wt = rotation.rotate_scan(
-                        hop_body, hop_carry, wt, w, shift=shift)
+                    if quant_wt:
+                        hop_carry, wt, wt_res = rotation.rotate_scan(
+                            hop_body, hop_carry, wt, w, shift=shift,
+                            comm=wt_comm, ef_state=wt_res,
+                            fused_dma=cfg.fused_dma)
+                    else:
+                        hop_carry, wt = rotation.rotate_scan(
+                            hop_body, hop_carry, wt, w, shift=shift,
+                            fused_dma=cfg.fused_dma)
                 else:
                     # local (2*vpb, K) block = [a-half; b-half]; 2w micro-steps
                     # bring both halves home again
-                    hop_carry, sa, sb = rotation.pipelined_rotation(
-                        micro_body, hop_carry, wt[:vpb], wt[vpb:], 2 * w,
-                        shift=shift)
+                    if quant_wt:
+                        hop_carry, sa, sb, wt_res = rotation.pipelined_rotation(
+                            micro_body, hop_carry, wt[:vpb], wt[vpb:], 2 * w,
+                            shift=shift, comm=wt_comm, ef_state=wt_res,
+                            fused_dma=cfg.fused_dma)
+                    else:
+                        hop_carry, sa, sb = rotation.pipelined_rotation(
+                            micro_body, hop_carry, wt[:vpb], wt[vpb:], 2 * w,
+                            shift=shift, fused_dma=cfg.fused_dma)
                     wt = jnp.concatenate([sa, sb], axis=0)
                 if comm is None:
                     doc_topic, z, topic_tot, key = hop_carry
@@ -531,6 +572,8 @@ class LDA:
                 else:
                     doc_topic, z, topic_tot, key, qres = hop_carry
                     out = (doc_topic, z, topic_tot, wt, key, qres)
+                if quant_wt:
+                    out = out + (wt_res,)
                 ll = ref_ll(wt, topic_tot)
                 return out, ll
 
@@ -538,6 +581,11 @@ class LDA:
                       if comm is None else
                       (doc_topic, z0, topic_tot, wt_block0, key,
                        jnp.zeros((k,), jnp.float32)))
+            if quant_wt:
+                wt_res0 = (rotation.ef_zero(wt_block0) if ns == 1 else
+                           (rotation.ef_zero(wt_block0[:vpb]),
+                            rotation.ef_zero(wt_block0[vpb:])))
+                state0 = state0 + (wt_res0,)
             state, ll = jax.lax.scan(epoch, state0, None, length=cfg.epochs)
             doc_topic, z, _, wt = state[:4]
             return doc_topic, wt, z, ll

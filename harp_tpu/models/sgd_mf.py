@@ -70,6 +70,7 @@ import numpy as np
 
 from harp_tpu import telemetry
 from harp_tpu.collectives import lax_ops, quantize, rotation
+from harp_tpu.ops import ring_dma
 from harp_tpu.ops import lane_pack, pallas_kernels
 from harp_tpu.parallel.mesh import fetch
 from harp_tpu.session import HarpSession
@@ -98,6 +99,16 @@ class SGDMFConfig:
     #                              per-codec RMSE tolerance).
     dense_max_bytes: int = 6_000_000_000  # per-worker slab budget for auto-dense
     balance: bool = True       # serpentine-LPT id balancing for the sparse layout
+    fused_dma: bool = False    # r10: H-block rotation hops ride the fused
+    #   ring-DMA engine (ops/ring_dma) instead of ppermute. On TPU with the
+    #   fused dense hop kernel live, the hop fuses INTO the kernel
+    #   (dense_mf_hop_pallas ring_hop: H leaves VMEM straight for the
+    #   neighbor's HBM — the ppermute staging round trips vanish); every
+    #   other path hops through ring_dma.hop. Bitwise-identical to the
+    #   ppermute schedule on every backend (the engine moves bytes, it
+    #   never rounds); off-TPU the tagged fallback keeps the jaxpr budget's
+    #   fused_dma rows honest. A quantized wire (quant=) takes precedence
+    #   over fusion (rotation.py module doc).
 
 
 # --------------------------------------------------------------------------- #
@@ -251,13 +262,19 @@ class SGDMF:
         return (wid - t) % w
 
     def _build(self, w: int, num_data_args: int,
-               make_update_bucket: Callable, epochs: int):
+               make_update_bucket: Callable, epochs: int,
+               body_hops: bool = False):
         """Shared rotation/epoch harness for both layouts.
 
         ``make_update_bucket(local_data)`` receives the worker-local shards of
         the data arrays (leading worker axis stripped) and returns
         ``update_bucket(w_local, h_block, sse, cnt, bucket_id)`` — the only
         part that differs between the sparse and dense programs.
+
+        ``body_hops``: the update itself performs the ring hop (the fused
+        dense kernel's in-kernel remote-copy epilogue returns the NEXT
+        resident block), so the rotation scan runs shift=0 — the schedule
+        is unchanged, only the transport moved into the kernel.
         """
         cfg = self.config
         two_slice = cfg.num_slices == 2
@@ -277,7 +294,9 @@ class SGDMF:
             rotator = rotation.Rotator(
                 w, cfg.num_slices,
                 comm=(quantize.CommConfig(quant=cfg.quant)
-                      if cfg.quant is not None else None))
+                      if cfg.quant is not None else None),
+                fused_dma=cfg.fused_dma and not body_hops,
+                shift=0 if body_hops else 1)
 
             def epoch(state, _):
                 w_local, h = state
@@ -351,6 +370,26 @@ class SGDMF:
         lr, lam = self.config.lr, self.config.lam
         s_rows = rpw // nmb
         bf = jnp.bfloat16
+        # dense-stripe tiling rides the shared lane engine's constant:
+        # a fused-hop column tile must be a whole number of 128-lane
+        # MXU tiles AND divide the column block
+        col_tile = next((ct for ct in (4 * lane_pack.LANES,
+                                       2 * lane_pack.LANES,
+                                       lane_pack.LANES)
+                         if cpb % ct == 0), 0)
+        fused = col_tile and pallas_kernels.use_dense_mf_pallas(
+            cpb, s_rows, self.config.rank)
+        # in-kernel ring hop (r10): fused dense kernel + fused_dma + a plain
+        # (unquantized) multi-worker wire (quant takes the encode path) on
+        # the 1-slice schedule ONLY — the kernel's blocking send+wait would
+        # defeat the 2-slice pipeline's compute/DMA overlap, so 2-slice
+        # keeps the out-of-kernel fused hop. The kernel then returns the
+        # already-hopped H block, so _build runs the rotation scan with
+        # shift=0 (body_hops).
+        ring_hop = bool(fused and self.config.fused_dma and w > 1
+                        and self.config.num_slices == 1
+                        and self.config.quant is None
+                        and ring_dma.use_ring_dma())
 
         def make_update_bucket(data):
             # missing entries are NaN-encoded in the value slab — no separate
@@ -359,10 +398,22 @@ class SGDMF:
             v_slab, row_cnt, col_cnt = data
 
             def _run_stripes_pallas(w_local, h_block, sse, cnt, vb, rcnt,
-                                    ccnt, col_tile):
+                                    ccnt, col_tile, ring_hop):
                 # fused hop kernel: pred/G stay in VMEM → one slab read per
                 # hop instead of XLA's ~5 slab-sized passes (pallas_kernels
-                # module doc). Factors ride transposed (K, rows).
+                # module doc). Factors ride transposed (K, rows). With
+                # ring_hop the kernel ALSO ships the updated H to the ring
+                # neighbor (VMEM → remote HBM, ops/ring_dma) and the
+                # returned block is the received one — the rotation scan
+                # then runs shift=0 (body_hops).
+                if ring_hop:
+                    w_t, _h_t, hop_sse, h_next = (
+                        pallas_kernels.dense_mf_hop_pallas(
+                            vb, w_local.T, h_block.T,
+                            rcnt.reshape(nmb, s_rows), ccnt, lr, lam,
+                            col_tile=col_tile, ring_hop=True))
+                    return (w_t.T, h_next.T, sse + hop_sse,
+                            cnt + jnp.sum(ccnt))
                 w_t, h_t, hop_sse = pallas_kernels.dense_mf_hop_pallas(
                     vb, w_local.T, h_block.T, rcnt.reshape(nmb, s_rows),
                     ccnt, lr, lam, col_tile=col_tile)
@@ -403,16 +454,6 @@ class SGDMF:
                 cnt = cnt + jnp.sum(ccnt)
                 return w_new.reshape(rpw, -1), h_block, sse, cnt
 
-            # dense-stripe tiling rides the shared lane engine's constant:
-            # a fused-hop column tile must be a whole number of 128-lane
-            # MXU tiles AND divide the column block
-            col_tile = next((ct for ct in (4 * lane_pack.LANES,
-                                           2 * lane_pack.LANES,
-                                           lane_pack.LANES)
-                             if cpb % ct == 0), 0)
-            fused = col_tile and pallas_kernels.use_dense_mf_pallas(
-                cpb, s_rows, self.config.rank)
-
             def update_bucket(w_local, h_block, sse, cnt, bucket_id):
                 if v_slab.shape[0] == 1:
                     # single-block mesh (W=1, 1 slice): static index — the
@@ -427,13 +468,15 @@ class SGDMF:
                 ccnt = ccnt.reshape(nmb, nmb_fine // nmb, cpb).sum(axis=1)
                 if fused:
                     return _run_stripes_pallas(w_local, h_block, sse, cnt,
-                                               vb, rcnt, ccnt, col_tile)
+                                               vb, rcnt, ccnt, col_tile,
+                                               ring_hop)
                 return _run_stripes(w_local, h_block, sse, cnt, vb, rcnt,
                                     ccnt)
 
             return update_bucket
 
-        return self._build(w, 3, make_update_bucket, epochs)
+        return self._build(w, 3, make_update_bucket, epochs,
+                           body_hops=ring_hop)
 
     def _program(self, layout: str, nmb: int, epochs: int, geom: Tuple):
         """Compile (or fetch) the SPMD program for a given per-hop budget.

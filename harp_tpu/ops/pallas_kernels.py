@@ -180,8 +180,13 @@ def kmeans_stats_pallas(
 
 
 def _dense_mf_hop_kernel(v_ref, wt_ref, rc_ref, cc_ref, ht_in_ref,
-                         wt_out_ref, ht_ref, sse_ref, dw_ref,
-                         *, lr: float, lam: float, col_tile: int, n_ct: int):
+                         wt_out_ref, ht_ref, sse_ref, *refs,
+                         lr: float, lam: float, col_tile: int, n_ct: int,
+                         nmb: int = 1, ring: Optional[dict] = None):
+    if ring is not None:
+        hn_ref, dw_ref, send_sem, recv_sem = refs
+    else:
+        (dw_ref,) = refs
     i = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -222,15 +227,45 @@ def _dense_mf_hop_kernel(v_ref, wt_ref, rc_ref, cc_ref, ht_in_ref,
         rc = rc_ref[0:1, :]                       # (1, s): stripe i's counts
         wt_out_ref[...] = wt + lr * (dw_ref[...] - lam * rc * wt)
 
+    if ring is not None:
+        from harp_tpu.ops import ring_dma
+
+        @pl.when((i == nmb - 1) & (j == n_ct - 1))
+        def _ring_send():
+            # r10 fused rotation hop — the first consumer of the shared
+            # ring engine: H is resident in VMEM for the whole kernel, so
+            # the hop DMAs it VMEM → remote HBM directly. ppermute instead
+            # costs writing H to HBM, reading it into the collective's
+            # staging buffer, and writing it out on the receiver — two
+            # whole-H HBM round trips this send skips. The send can only
+            # start once the last stripe's update lands (the hop ships the
+            # UPDATED block), so it does not overlap this hop's compute;
+            # the overlap schedule stays the rotation scan's job.
+            ax, nw = ring["axis_name"], ring["num_workers"]
+            ring_dma.ring_ready(ax, nw, 1)
+            ring_dma.start_hop(ht_ref, hn_ref, send_sem, recv_sem, ax, nw,
+                               1).wait()
+
 
 def dense_mf_hop_pallas(vb: jax.Array, w_t: jax.Array, h_t: jax.Array,
                         rc2: jax.Array, cc2: jax.Array, lr: float, lam: float,
-                        col_tile: int = 256, interpret: bool = False
-                        ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+                        col_tile: int = 256, interpret: bool = False,
+                        ring_hop: bool = False, axis_name: str = "workers"):
     """One dense-MF hop. vb (rpw, cpb) bf16 NaN-encoded; w_t (K, rpw) f32;
     h_t (K, cpb) f32; rc2 (nmb, s_rows) and cc2 (nmb, cpb) regularizer
-    counts. Returns (w_t_new, h_t_new, sse). nmb = rc2.shape[0]."""
+    counts. Returns (w_t_new, h_t_new, sse). nmb = rc2.shape[0].
+
+    ``ring_hop`` (TPU only, inside shard_map over ``axis_name``): also
+    ring-ship the UPDATED H block to the right neighbor from inside the
+    kernel (ops/ring_dma engine; kernel comment) and return
+    ``(w_t_new, h_t_new, sse, h_t_next)`` — ``h_t_next`` is the block this
+    worker receives, i.e. what ``lax_ops.rotate(h, 1)`` would deliver; the
+    caller's rotation scan must then run shift=0."""
     from jax.experimental.pallas import tpu as pltpu
+
+    if ring_hop and interpret:
+        raise ValueError("ring_hop=True has no interpret-mode lowering "
+                         "(remote DMA is not emulated off-TPU)")
 
     nmb, s = rc2.shape
     k, rpw = w_t.shape
@@ -240,8 +275,15 @@ def dense_mf_hop_pallas(vb: jax.Array, w_t: jax.Array, h_t: jax.Array,
     if cpb % col_tile or s % 8 or k % 8 or col_tile % 128:
         raise ValueError("dense_mf_hop_pallas: tiling constraints violated")
     n_ct = cpb // col_tile
+    ring = None
+    if ring_hop:
+        from harp_tpu.collectives import lax_ops as _lax_ops
+
+        ring = {"axis_name": axis_name,
+                "num_workers": _lax_ops.num_workers(axis_name)}
     kernel = functools.partial(_dense_mf_hop_kernel, lr=lr, lam=lam,
-                               col_tile=col_tile, n_ct=n_ct)
+                               col_tile=col_tile, n_ct=n_ct, nmb=nmb,
+                               ring=ring)
     # per-stripe count rows ride in 8-sublane-replicated blocks: mosaic
     # cannot vector-load a single DYNAMIC sublane row, so give each stripe an
     # aligned (8, ·) block and read its (static) first row in-kernel
@@ -254,7 +296,26 @@ def dense_mf_hop_pallas(vb: jax.Array, w_t: jax.Array, h_t: jax.Array,
     vmem_bytes = 1.3 * (2 * k * cpb * 4 + s * col_tile * 2 + 2 * k * s * 4
                         + k * s * 2 + 4 * s * col_tile
                         + 2 * k * col_tile * 4) + (8 << 20)
-    w_t_new, h_t_new, sse128 = pl.pallas_call(
+    out_specs = [
+        pl.BlockSpec((k, s), lambda i, j: (0, i)),              # w_t_new
+        pl.BlockSpec((k, cpb), lambda i, j: (0, 0)),            # h_t_new
+        pl.BlockSpec((1, 128), lambda i, j: (0, 0)),            # sse
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((k, rpw), jnp.float32),
+        jax.ShapeDtypeStruct((k, cpb), jnp.float32),
+        jax.ShapeDtypeStruct((1, 128), jnp.float32),
+    ]
+    scratch_shapes = [pltpu.VMEM((k, s), jnp.float32)]
+    params = {"vmem_limit_bytes": min(int(vmem_bytes), 100 * 1024 * 1024)}
+    if ring is not None:
+        out_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))  # h_t_next
+        out_shape.append(jax.ShapeDtypeStruct((k, cpb), jnp.float32))
+        scratch_shapes += [pltpu.SemaphoreType.DMA] * 2
+        from harp_tpu.ops import ring_dma as _rd
+
+        params["collective_id"] = _rd.COLLECTIVE_IDS["dense_mf_ring"]
+    outs = pl.pallas_call(
         kernel,
         grid=(nmb, n_ct),
         in_specs=[
@@ -264,22 +325,16 @@ def dense_mf_hop_pallas(vb: jax.Array, w_t: jax.Array, h_t: jax.Array,
             pl.BlockSpec((8, col_tile), lambda i, j: (i, j)),       # cc8
             pl.BlockSpec((k, cpb), lambda i, j: (0, 0)),            # h_t full
         ],
-        out_specs=[
-            pl.BlockSpec((k, s), lambda i, j: (0, i)),              # w_t_new
-            pl.BlockSpec((k, cpb), lambda i, j: (0, 0)),            # h_t_new
-            pl.BlockSpec((1, 128), lambda i, j: (0, 0)),            # sse
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((k, rpw), jnp.float32),
-            jax.ShapeDtypeStruct((k, cpb), jnp.float32),
-            jax.ShapeDtypeStruct((1, 128), jnp.float32),
-        ],
-        scratch_shapes=[pltpu.VMEM((k, s), jnp.float32)],
-        compiler_params=compat.tpu_compiler_params(
-            pltpu,
-            vmem_limit_bytes=min(int(vmem_bytes), 100 * 1024 * 1024)),
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch_shapes,
+        compiler_params=compat.tpu_compiler_params(pltpu, **params),
         interpret=interpret,
     )(vb, w_t, rc8, cc8, h_t)
+    if ring is not None:
+        w_t_new, h_t_new, sse128, h_next = outs
+        return w_t_new, h_t_new, jnp.sum(sse128), h_next
+    w_t_new, h_t_new, sse128 = outs
     return w_t_new, h_t_new, jnp.sum(sse128)
 
 
@@ -345,18 +400,57 @@ def _flash_grid_layout(n_q: int, n_kv: int, bq: int, bk: int, causal: bool):
 
 def _flash_kernel(iq_ref, j_ref, q_ref, k_ref, v_ref, *refs,
                   bq: int, bk: int, n_kv: int, causal: bool, scale: float,
-                  l_real: int, packed: bool, return_stats: bool):
+                  l_real: int, packed: bool, return_stats: bool,
+                  ring: Optional[dict] = None, n_heads: int = 1,
+                  n_steps: int = 1):
     """One flat-grid step: fold KV block j_of[t] into q tile iq_of[t].
 
     Scratch m/d are (bq, 128): unpacked they are row-replicated; packed,
-    lanes [0,64) carry the even head and [64,128) the odd head."""
-    if return_stats:
+    lanes [0,64) carry the even head and [64,128) the odd head.
+
+    ``ring`` (the r10 remote-copy epilogue; requires ``return_stats``):
+    {"axis_name", "num_workers"} — two extra ANY-space inputs carry the
+    full packed K/V (aliases of the blocked operands), two extra ANY-space
+    outputs receive the NEXT hop's K/V. At the FIRST grid step the kernel
+    barriers the ring and STARTS both whole-array remote copies; it WAITS
+    at the LAST grid step — so the neighbor's KV streams in over the ICI
+    DMA engines while this whole flash pass computes, which is exactly how
+    the ring-attention hop hides (arXiv:2310.01889) — and the payload never
+    takes the ppermute staging round trip through HBM."""
+    if ring is not None:
+        (o_ref, m_out_ref, d_out_ref, kn_ref, vn_ref,
+         m_ref, d_ref, acc_ref, send_sems, recv_sems) = refs[2:]
+        kh_ref, vh_ref = refs[:2]
+    elif return_stats:
         o_ref, m_out_ref, d_out_ref, m_ref, d_ref, acc_ref = refs
     else:
         o_ref, m_ref, d_ref, acc_ref = refs
+    hh = pl.program_id(0)
     t = pl.program_id(1)
     iq = iq_ref[t]
     j = j_ref[t]
+
+    if ring is not None:
+        from harp_tpu.ops import ring_dma
+
+        ax, nw = ring["axis_name"], ring["num_workers"]
+
+        @pl.when((hh == 0) & (t == 0))
+        def _ring_start():
+            ring_dma.ring_ready(ax, nw, 1)
+            ring_dma.start_hop(kh_ref, kn_ref, send_sems.at[0],
+                               recv_sems.at[0], ax, nw, 1)
+            ring_dma.start_hop(vh_ref, vn_ref, send_sems.at[1],
+                               recv_sems.at[1], ax, nw, 1)
+
+        @pl.when((hh == n_heads - 1) & (t == n_steps - 1))
+        def _ring_wait():
+            # rebuild the identical descriptors to wait (ring_dma.hop_op
+            # doc): the DMAs have had the whole pass to land
+            ring_dma.hop_op(kh_ref, kn_ref, send_sems.at[0],
+                            recv_sems.at[0], ax, nw, 1).wait()
+            ring_dma.hop_op(vh_ref, vn_ref, send_sems.at[1],
+                            recv_sems.at[1], ax, nw, 1).wait()
 
     @pl.when(j == 0)
     def _init():
@@ -449,7 +543,9 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
                            causal: bool = False, bq: int = 256, bk: int = 512,
                            interpret: bool = False,
                            head_pack: Optional[bool] = None,
-                           return_stats: bool = False):
+                           return_stats: bool = False,
+                           ring_hop: bool = False,
+                           axis_name: str = "workers"):
     """Single-chip flash attention: q/k (L, H, Dh), v (L, H, Dv) →
     (L, H, Dv).
 
@@ -473,8 +569,28 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
     other KV blocks' partial attention (the ring-attention hop composition:
     num = out·den). Stats rows for padded queries are sliced off with the
     output.
+
+    ``ring_hop`` (requires ``return_stats``; TPU only — must be called
+    inside shard_map over ``axis_name``): the r10 fused ring epilogue. The
+    kernel ALSO ships this hop's K/V to the right ring neighbor via
+    in-kernel ``make_async_remote_copy`` (start at the first grid step
+    after a neighbor barrier, wait at the last — the DMA hides behind the
+    whole flash pass) and the call returns two extra arrays
+    ``(k_next, v_next)``, each (L, H, D): the NEXT hop's resident KV,
+    bitwise the ``lax_ops.rotate`` result, without the ppermute staging
+    round trip through HBM. ``parallel.ring_attention.ring_attention_mha``
+    is the consumer.
     """
     from jax.experimental.pallas import tpu as pltpu
+
+    if ring_hop and not return_stats:
+        raise ValueError("ring_hop=True requires return_stats=True (the "
+                         "ring merge needs the streaming-softmax stats)")
+    if ring_hop and interpret:
+        raise ValueError(
+            "ring_hop=True has no interpret-mode lowering (remote DMA is "
+            "not emulated off-TPU) — ring_attention_mha's fused path "
+            "routes off-TPU hops through ops/ring_dma.hop instead")
 
     l, h, dh = q.shape
     dv = v.shape[-1]
@@ -526,9 +642,21 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
                      ((0, 0), (0, l_pad_kv - l), (0, d_k - dh)))
         vt = jnp.pad(jnp.transpose(v, (1, 0, 2)),
                      ((0, 0), (0, l_pad_kv - l), (0, d_v - dv)))
+    ring = None
+    if ring_hop:
+        from harp_tpu.collectives import lax_ops as _lax_ops
+
+        ring = {"axis_name": axis_name,
+                "num_workers": _lax_ops.num_workers(axis_name)}
     kernel = functools.partial(_flash_kernel, bq=bq, bk=bk, n_kv=n_kv,
                                causal=causal, scale=scale, l_real=l,
-                               packed=packed, return_stats=return_stats)
+                               packed=packed, return_stats=return_stats,
+                               ring=ring, n_heads=h_dim, n_steps=len(iq_of))
+    in_specs = [
+        pl.BlockSpec((1, bq, d_q), lambda hh, t, iqr, jr: (hh, iqr[t], 0)),
+        pl.BlockSpec((1, bk, d_k), lambda hh, t, iqr, jr: (hh, jr[t], 0)),
+        pl.BlockSpec((1, bk, d_v), lambda hh, t, iqr, jr: (hh, jr[t], 0)),
+    ]
     out_shape = [jax.ShapeDtypeStruct((h_dim, l_pad_q, d_v), jnp.float32)]
     out_specs = [pl.BlockSpec((1, bq, d_v),
                               lambda hh, t, iqr, jr: (hh, iqr[t], 0))]
@@ -538,25 +666,41 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
                 jax.ShapeDtypeStruct((h_dim, l_pad_q, 128), jnp.float32))
             out_specs.append(pl.BlockSpec(
                 (1, bq, 128), lambda hh, t, iqr, jr: (hh, iqr[t], 0)))
+    scratch_shapes = [
+        pltpu.VMEM((bq, 128), jnp.float32),        # running max
+        pltpu.VMEM((bq, 128), jnp.float32),        # running denominator
+        pltpu.VMEM((bq, d_v), jnp.float32),        # output accumulator
+    ]
+    call_kwargs = {}
+    if ring is not None:
+        # the packed K/V ride AGAIN as un-blocked ANY-space operands (the
+        # DMA source must see the whole array, the blocked specs only see
+        # per-step tiles) and two ANY-space outputs receive the neighbor's
+        # blocks; per-direction double-buffered send/recv semaphore pairs
+        in_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
+        out_shape += [jax.ShapeDtypeStruct(kt.shape, kt.dtype),
+                      jax.ShapeDtypeStruct(vt.shape, vt.dtype)]
+        out_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
+        scratch_shapes += [pltpu.SemaphoreType.DMA((2,)),
+                           pltpu.SemaphoreType.DMA((2,))]
+        from harp_tpu.ops import ring_dma as _rd
+
+        call_kwargs["compiler_params"] = compat.tpu_compiler_params(
+            pltpu, collective_id=_rd.COLLECTIVE_IDS["flash_ring"])
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,                     # iq_of, j_of
         grid=(h_dim, len(iq_of)),
-        in_specs=[
-            pl.BlockSpec((1, bq, d_q), lambda hh, t, iqr, jr: (hh, iqr[t], 0)),
-            pl.BlockSpec((1, bk, d_k), lambda hh, t, iqr, jr: (hh, jr[t], 0)),
-            pl.BlockSpec((1, bk, d_v), lambda hh, t, iqr, jr: (hh, jr[t], 0)),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
-        scratch_shapes=[
-            pltpu.VMEM((bq, 128), jnp.float32),    # running max
-            pltpu.VMEM((bq, 128), jnp.float32),    # running denominator
-            pltpu.VMEM((bq, d_v), jnp.float32),    # output accumulator
-        ],
+        scratch_shapes=scratch_shapes,
     )
+    args = [jnp.asarray(iq_of), jnp.asarray(j_of), qt, kt, vt]
+    if ring is not None:
+        args += [kt, vt]
     outs = pl.pallas_call(
         kernel, grid_spec=grid_spec, out_shape=out_shape,
-        interpret=interpret,
-    )(jnp.asarray(iq_of), jnp.asarray(j_of), qt, kt, vt)
+        interpret=interpret, **call_kwargs,
+    )(*args)
     if packed:
         o = jnp.transpose(outs[0], (1, 0, 2)).reshape(
             l_pad_q, h, _PACK_LANES)[:l, :, :dv]
@@ -571,7 +715,20 @@ def flash_attention_pallas(q: jax.Array, k: jax.Array, v: jax.Array,
             return jnp.transpose(st, (1, 0, 2)).reshape(l_pad_q, h)[:l]
         return jnp.transpose(raw[..., 0])[:l]
 
-    return o, unpack_stat(outs[1]), unpack_stat(outs[2])
+    if ring is None:
+        return o, unpack_stat(outs[1]), unpack_stat(outs[2])
+
+    def unpack_kv(raw, d_real):
+        # inverse of the pack/transpose: the DMA moved the packed layout
+        # verbatim, so slicing the zero padding back off recovers the
+        # neighbor's (L, H, D) block bitwise
+        if packed:
+            return jnp.transpose(raw, (1, 0, 2)).reshape(
+                l_pad_kv, h, _PACK_LANES)[:l, :, :d_real]
+        return jnp.transpose(raw, (1, 0, 2))[:l, :, :d_real]
+
+    return (o, unpack_stat(outs[1]), unpack_stat(outs[2]),
+            unpack_kv(outs[3], dh), unpack_kv(outs[4], dv))
 
 
 def use_flash_pallas(l: int) -> bool:

@@ -145,52 +145,111 @@ def _hop_stats(q, kb, vb, scale, diag_causal: bool, use_flash: bool,
 def ring_attention_mha(q: jax.Array, k: jax.Array, v: jax.Array,
                        causal: bool = False, axis_name: str = WORKERS,
                        use_flash: Optional[bool] = None,
-                       interpret: bool = False) -> jax.Array:
+                       interpret: bool = False,
+                       fused_dma: Optional[bool] = None,
+                       ablate_rotation: bool = False) -> jax.Array:
     """Multi-head ring attention: q/k/v (L/W, H, Dh) → (L/W, H, Dv).
 
-    One ppermute ring per hop carries all heads; each hop folds the
-    resident KV block into the running streaming softmax. r7: hops are
-    native multi-head and dispatch through the flash kernel on TPU
+    One ring hop per step carries all heads; each hop folds the resident
+    KV block into the running streaming softmax. r7: hops are native
+    multi-head and dispatch through the flash kernel on TPU
     (``use_flash=None`` → :func:`~harp_tpu.ops.pallas_kernels.use_flash_pallas`
     on the local block length): hop 0 — the only partially-masked hop of a
     causal ring — runs the block-sparse causal trapezoid; hops t ≥ 1 run
     unmasked full attention and are kept or dropped WHOLE by the merge's
     validity flag (``wid >= t``), so no per-hop (Lq, Lk) mask is ever
     built for them. Drop-in peer of :func:`ulysses_attention` for the
-    sequence-sharded layout."""
+    sequence-sharded layout.
+
+    r10 — ``fused_dma`` (None = :func:`~harp_tpu.ops.ring_dma.use_ring_dma`,
+    i.e. on for TPU): the KV hop rides the fused ring-DMA engine. On TPU
+    with the flash kernel live, the hop FUSES INTO the kernel
+    (``flash_attention_pallas(ring_hop=True)``): the kernel ships this
+    hop's KV to the ring neighbor while its own grid computes, so the hop
+    hides entirely behind block compute (arXiv:2310.01889) and the payload
+    skips the ppermute staging round trip. Off TPU (or with the XLA einsum
+    hop) the same schedule runs with :func:`~harp_tpu.ops.ring_dma.hop`
+    per hop — bitwise the ppermute schedule, and the jaxpr budget books
+    the bytes as ``fused_dma``.
+
+    ``ablate_rotation``: timing ablation ONLY — keeps the per-hop compute
+    schedule but never moves the KV block (results are WRONG); used by the
+    ring_dma overlap bench to bound the non-overlapped hop share, exactly
+    like ``LDAConfig.ablate_rotation``."""
     w = compat.axis_size(axis_name)
     wid = lax_ops.worker_id(axis_name)
     lq = q.shape[0]
     scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
-    if use_flash is None:
-        from harp_tpu.ops import pallas_kernels as _pk
+    from harp_tpu.ops import pallas_kernels as _pk
+    from harp_tpu.ops import ring_dma
 
+    if use_flash is None:
         use_flash = _pk.use_flash_pallas(lq)
+    if fused_dma is None:
+        fused_dma = ring_dma.use_ring_dma()
+    in_kernel = (fused_dma and use_flash and not interpret
+                 and ring_dma.use_ring_dma() and w > 1
+                 and not ablate_rotation)
+
+    def hop_valid(tm1, m_r):
+        if causal:
+            # hop t holds worker (wid - t) mod w's block: fully live when
+            # it is before this worker's rows (wid >= t), fully dead when
+            # it wrapped around — no partial masks after hop 0
+            return jnp.broadcast_to(wid >= tm1 + 1, m_r.shape)
+        return jnp.ones(m_r.shape, bool)
+
+    if in_kernel:
+        # fused schedule: EVERY hop's kernel ships its resident KV onward
+        # while computing, so the scan body consumes the block the previous
+        # kernel already received — no out-of-kernel collective at all.
+        # (The last hop's send returns the blocks home; a w-th of the ring
+        # traffic, kept so the scan body stays uniform.)
+        out0, m_run, den, kb0, vb0 = _pk.flash_attention_pallas(
+            q, k, v, causal=causal, return_stats=True, ring_hop=True,
+            axis_name=axis_name)
+        num = out0 * den[..., None]
+
+        def step(carry, tm1):
+            (m_r, nu, de), (kb, vb) = carry
+            out_b, m_b, den_b, kn, vn = _pk.flash_attention_pallas(
+                q, kb, vb, causal=False, return_stats=True, ring_hop=True,
+                axis_name=axis_name)
+            m_r, nu, de = _softmax_merge(m_r, nu, de, m_b,
+                                         out_b * den_b[..., None], den_b,
+                                         hop_valid(tm1, m_r))
+            return ((m_r, nu, de), (kn, vn)), None
+
+        ((m_run, num, den), _), _ = jax.lax.scan(
+            step, ((m_run, num, den), (kb0, vb0)), jnp.arange(w - 1))
+        return num / jnp.maximum(den, 1e-30)[..., None]
+
     # hop 0: the resident block is this worker's own — the diagonal (and,
     # for causal, the ONLY partially-masked block); every row keeps >= 1 key
     m_run, num, den = _hop_stats(q, k, v, scale, causal, use_flash,
                                  interpret)
     if w > 1:
-        kv = jax.tree.map(lambda x: lax_ops.rotate(x, 1, axis_name), (k, v))
+        shift = 0 if ablate_rotation else 1
+        if ablate_rotation:
+            kv = (k, v)
+        elif fused_dma:
+            kv = ring_dma.hop_tree((k, v), 1, axis_name)
+        else:
+            kv = jax.tree.map(lambda x: lax_ops.rotate(x, 1, axis_name),
+                              (k, v))
 
         def body(carry, kv_block, tm1):
             m_r, nu, de = carry
             kb, vb = kv_block
             m_b, num_b, den_b = _hop_stats(q, kb, vb, scale, False,
                                            use_flash, interpret)
-            if causal:
-                # hop t holds worker (wid - t) mod w's block: fully live
-                # when it is before this worker's rows (wid >= t), fully
-                # dead when it wrapped around — no partial masks after hop 0
-                valid = jnp.broadcast_to(wid >= tm1 + 1, m_r.shape)
-            else:
-                valid = jnp.ones(m_r.shape, bool)
             m_r, nu, de = _softmax_merge(m_r, nu, de, m_b, num_b, den_b,
-                                         valid)
+                                         hop_valid(tm1, m_r))
             return (m_r, nu, de), (kb, vb)
 
         (m_run, num, den), _ = rotation.rotate_scan(
-            body, (m_run, num, den), kv, w - 1, axis_name)
+            body, (m_run, num, den), kv, w - 1, axis_name, shift=shift,
+            fused_dma=fused_dma)
     return num / jnp.maximum(den, 1e-30)[..., None]
 
 

@@ -6,7 +6,14 @@
 #                     excepts, scatters, collective-budget pinning, dtype
 #                     policy, and JL203 byte budgets: per-step collective
 #                     operand BYTES incl. the quantized trace targets — a
-#                     quantized path silently reverting to f32 fails here);
+#                     quantized path silently reverting to f32 fails here.
+#                     r10: the manifest also pins fused ring-DMA targets
+#                     (lda_cgs_fused, sgd_mf_dense_fused, and the
+#                     quantized-wt lda_cgs_quantwt_int8): their rotation
+#                     hops are booked as the `fused_dma` kind with explicit
+#                     fused_dma_bytes_per_step rows, so a fused schedule
+#                     silently reverting to bare ppermute moves bytes
+#                     between kinds and fails here too);
 #                     nonzero on any finding or stale allowlist entry.
 #   2. telemetry    — the jaxpr engine re-run with the gang telemetry layer
 #                     ENABLED (HARP_TELEMETRY_DIR set): the instrumented

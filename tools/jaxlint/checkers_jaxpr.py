@@ -48,6 +48,20 @@ COLLECTIVE_PRIMS = {
     "psum_scatter", "ppermute", "pshuffle", "pbroadcast", "pgather",
 }
 
+# Fused ring-DMA hops (r10): on TPU these are in-kernel
+# `make_async_remote_copy`s with NO collective primitive in the jaxpr; on
+# the CPU tracing mesh the engine lowers them through a jit tagged with
+# this name (must equal harp_tpu.ops.ring_dma.FUSED_HOP_NAME — tier-1
+# asserts the two constants agree). The walker books a tagged call's
+# operand bytes as the synthetic kind "fused_dma" and does NOT recurse into
+# it — the inner ppermute is the transport the tag REPLACES, so counting
+# both would double-charge, and counting only the ppermute would let a
+# silent revert to a bare permute keep the same byte row. The manifest pins
+# the kind per target (plus the explicit `fused_dma_bytes_per_step` field),
+# so a fused schedule quietly degrading to ppermute moves bytes BETWEEN
+# kinds and fails JL201/JL203.
+FUSED_HOP_PREFIX = "ring_dma_fused_hop"
+
 
 def _subjaxprs(eqn):
     for v in eqn.params.values():
@@ -75,6 +89,13 @@ def _walk(jaxpr, counts: Dict[str, int], dtype_bad: List[str],
           nbytes: Dict[str, int]) -> None:
     for eqn in jaxpr.eqns:
         name = eqn.primitive.name
+        if (name == "pjit"
+                and str(eqn.params.get("name", "")).startswith(
+                    FUSED_HOP_PREFIX)):
+            counts["fused_dma"] = counts.get("fused_dma", 0) + 1
+            nbytes["fused_dma"] = nbytes.get("fused_dma", 0) + sum(
+                _aval_bytes(v) for v in eqn.invars)
+            continue     # no recursion: the tag REPLACES the inner permute
         if name in COLLECTIVE_PRIMS:
             counts[name] = counts.get(name, 0) + 1
             # operand bytes = what the collective puts on the wire at tier-1
@@ -158,13 +179,18 @@ def write_budget(repo_root: str, traced) -> str:
             "(e.g. a quantized path silently falling back to f32); "
             "regenerate deliberately with `python -m tools.jaxlint "
             "--update-budget` and review the diff. Counts/bytes are per "
-            "STEP (scan bodies count once)."),
+            "STEP (scan bodies count once). fused_dma_bytes_per_step pins "
+            "the bytes that move via in-kernel ring DMA "
+            "(ops/ring_dma fused hops — tagged jits on the tracing mesh): "
+            "a fused schedule silently reverting to bare ppermute moves "
+            "these bytes between kinds and fails the gate."),
         "traced_with_jax": jax.__version__,
         "targets": {
             name: {
                 "collectives": dict(sorted(counts.items())),
                 "bytes_per_step": sum(nbytes.values()),
                 "bytes_by_kind": dict(sorted(nbytes.items())),
+                "fused_dma_bytes_per_step": nbytes.get("fused_dma", 0),
             }
             for name, (counts, _bad, nbytes) in sorted(traced.items())},
     }
@@ -241,6 +267,27 @@ def check_budget(repo_root: str, traced=None) -> List[Finding]:
                  f"widening, e.g. a quantized path silently reverting to "
                  f"f32, lands here); if intentional, --update-budget and "
                  f"review the diff")
+        # fused ring-DMA contract: the explicit fused_dma_bytes_per_step
+        # row must exist for any target whose trace moves bytes via the
+        # fused engine, and must agree with the by-kind row (a fused target
+        # silently reverting to ppermute already failed the kind drift
+        # above — fused_dma bytes collapse to 0 and ppermute grows).
+        traced_fused = nbytes.get("fused_dma", 0)
+        pinned_fused = budget_targets[name].get("fused_dma_bytes_per_step")
+        if traced_fused and pinned_fused is None:
+            emit("JL203", "byte-budget", name,
+                 f"target {name!r} moves {traced_fused} B/step via fused "
+                 f"ring DMA but the manifest row has no "
+                 f"fused_dma_bytes_per_step — regenerate with "
+                 f"--update-budget so the fused contract covers it")
+        elif (pinned_fused is not None
+              and pinned_fused != pinned_kinds.get("fused_dma", 0)):
+            emit("JL203", "byte-budget", name,
+                 f"manifest inconsistency for {name!r}: "
+                 f"fused_dma_bytes_per_step={pinned_fused} disagrees with "
+                 f"bytes_by_kind fused_dma="
+                 f"{pinned_kinds.get('fused_dma', 0)} — hand-edited row? "
+                 f"regenerate with --update-budget")
     for name in sorted(set(budget_targets) - set(traced)):
         emit("JL201", "collective-budget", name,
              f"manifest entry {name!r} matches no trace target — stale row "

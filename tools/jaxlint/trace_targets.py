@@ -80,14 +80,18 @@ def _kmeans(comm: str, quant=None):
     return build
 
 
-def _lda():
-    from harp_tpu.models import lda
+def _lda(**cfg_kw):
+    def build():
+        from harp_tpu.models import lda
 
-    sess = _session()
-    model = lda.LDA(sess, lda.LDAConfig(num_topics=4, vocab=96, epochs=2))
-    docs = _rng().integers(0, 96, size=(16, 12))
-    key, data, seed, _meta = model.prepare(docs, seed=0)
-    return model._fns[key], (*data, seed)
+        sess = _session()
+        model = lda.LDA(sess, lda.LDAConfig(num_topics=4, vocab=96,
+                                            epochs=2, **cfg_kw))
+        docs = _rng().integers(0, 96, size=(16, 12))
+        key, data, seed, _meta = model.prepare(docs, seed=0)
+        return model._fns[key], (*data, seed)
+
+    return build
 
 
 def _lda_subblock():
@@ -101,13 +105,14 @@ def _lda_subblock():
     return model._fns[key], (*data, seed)
 
 
-def _sgd_mf(quant=None):
+def _sgd_mf(quant=None, fused_dma=False):
     def build():
         from harp_tpu.models import sgd_mf
 
         sess = _session()
         cfg = sgd_mf.SGDMFConfig(rank=8, lam=0.01, lr=0.1, epochs=2,
-                                 minibatches_per_hop=2, quant=quant)
+                                 minibatches_per_hop=2, quant=quant,
+                                 fused_dma=fused_dma)
         model = sgd_mf.SGDMF(sess, cfg)
         rng = _rng()
         n = 400
@@ -181,6 +186,13 @@ def _nn():
 # sit far below the f32 twins', so a quantized path silently reverting to
 # f32 (same collective counts, 2-4x the operand bytes) fails JL203 exactly
 # like count drift fails JL201.
+# The *_fused rows (r10) pin the fused ring-DMA step programs: the wt/H
+# rotation hops trace as the tagged `fused_dma` kind (checkers_jaxpr
+# FUSED_HOP_PREFIX) with the SAME bytes the f32 ppermute moved — a fused
+# schedule silently reverting to bare ppermute swaps those bytes back
+# between kinds and fails the gate. lda_cgs_quantwt_int8 pins the
+# satellite quantized wt-block rotation (ISSUE 9): its ppermute bytes sit
+# far below lda_cgs's because the (vpb, K) block ships int8+scales.
 TARGETS: Dict[str, Callable[[], Tuple[Callable, tuple]]] = {
     "kmeans_regroupallgather": _kmeans("regroupallgather"),
     "kmeans_allreduce": _kmeans("allreduce"),
@@ -190,10 +202,13 @@ TARGETS: Dict[str, Callable[[], Tuple[Callable, tuple]]] = {
     "kmeans_allreduce_int8": _kmeans("allreduce", quant="int8"),
     "kmeans_regroupallgather_bf16": _kmeans("regroupallgather",
                                             quant="bf16"),
-    "lda_cgs": _lda,
+    "lda_cgs": _lda(),
+    "lda_cgs_fused": _lda(fused_dma=True),
+    "lda_cgs_quantwt_int8": _lda(quant="int8", quant_wt=True),
     "lda_cgs_subblock128": _lda_subblock,
     "sgd_mf_dense": _sgd_mf(),
     "sgd_mf_dense_int8": _sgd_mf(quant="int8"),
+    "sgd_mf_dense_fused": _sgd_mf(fused_dma=True),
     "als_explicit": _als,
     "pagerank": _pagerank,
     "nn_mlp": _nn,
