@@ -953,6 +953,41 @@ def tpu_ring_dma_overlap(small=False):
     return row
 
 
+def tpu_serving(small=False):
+    """Online-serving load rows (ISSUE 10 acceptance): p50/p99 latency +
+    QPS at >=3 traffic mixes against a 2-worker local serving gang
+    (harp_tpu/serve/ router + continuous micro-batcher + resident
+    dispatches; benchmark/serving_load.py). The per-mix latency rows are
+    published THROUGH telemetry (record_timing -> steps.jsonl, same
+    percentile format as the straggler reports); the returned row carries
+    the telemetry event count as proof. Unlike the pure-device groups this
+    one always measures — the router/batcher stack is host-side — but the
+    row's `device` field says what the dispatches ran on, and a CPU-mesh
+    row carries the re-measure note for the driver's on-chip run."""
+    import tempfile
+
+    from harp_tpu import telemetry
+    from harp_tpu.benchmark import serving_load
+    from harp_tpu.session import HarpSession
+
+    sess = HarpSession()
+    tele_dir = tempfile.mkdtemp(prefix="harp-bench-serve-")
+    telemetry.configure(tele_dir, interval=1)
+    try:
+        row = serving_load.measure(
+            sess, requests_per_mix=300 if small else 900, num_clients=3)
+    finally:
+        telemetry.disable()
+    rank_file = os.path.join(tele_dir, "rank0", "steps.jsonl")
+    n_events = 0
+    if os.path.exists(rank_file):
+        with open(rank_file) as f:
+            n_events = sum(1 for line in f if '"kind": "timing"' in line)
+    row["telemetry_timing_events"] = n_events
+    row["telemetry_dir"] = tele_dir
+    return row
+
+
 def p2p_event_rtt_us(rounds=200):
     """Host event-plane round trip (send → wait_event → reply → wait): the
     latency the true P2P transport (authenticated, loopback) delivers.
@@ -1031,7 +1066,7 @@ ROW_GROUPS = ("kmeans", "kmeans_padded128", "kmeans_csr", "sgd_mf", "als",
               "nn_compute_bound", "attention", "attention_blocksparse",
               "kernel_svm", "mds", "sort", "csr_cov", "kmeans_from_files",
               "p2p", "mesh", "collectives_quantized", "telemetry_overhead",
-              "ring_dma_overlap")
+              "ring_dma_overlap", "serving")
 
 
 def main():
@@ -1431,6 +1466,21 @@ def main():
                 rrow["lda_rotation"].get("fused_hidden_fraction"))
             compact["ring_dma_attn_hidden_fraction"] = (
                 rrow["ring_attention"].get("fused_hidden_fraction"))
+
+    if want("serving"):
+        begin("serving")
+        try:
+            srow = tpu_serving(small)
+        except Exception as e:     # noqa: BLE001 — bench must not die here
+            srow = {"error": str(e)[:200]}
+        detail["serving"] = srow
+        if isinstance(srow, dict) and "mixes" in srow:
+            mixed = srow["mixes"].get("mixed", {})
+            compact.update({
+                "serving_mixed_p50_ms": mixed.get("p50_ms"),
+                "serving_mixed_p99_ms": mixed.get("p99_ms"),
+                "serving_mixed_qps": mixed.get("qps"),
+                "serving_device": srow.get("device")})
 
     detail["xeon_anchor_note"] = (
         f"vs_cpu = measured vs ONE modern Zen core (this host has 1 "

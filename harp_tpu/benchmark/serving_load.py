@@ -1,0 +1,244 @@
+"""Serving load generator — p50/p99 latency + QPS at mixed traffic.
+
+Drives a local serving gang (2 :class:`~harp_tpu.serve.router.ServeWorker`\\ s
+on authenticated loopback p2p — worker 0 owns the classify endpoint, worker
+1 the recsys top-k) with N closed-loop clients at >=3 traffic mixes, and
+reports per-mix request latency percentiles and sustained QPS.
+
+Protocol per mix:
+
+* every client runs its share of requests back-to-back (closed loop:
+  concurrency == number of clients — the batcher's coalescing window sees
+  at most ``clients`` in-flight requests, so the measured occupancy is the
+  honest low-traffic figure, not an open-loop flood);
+* the op per request follows a per-client seeded RNG at the mix's top-k
+  fraction, ids/feature vectors drawn from the served id/feature space;
+* latency = submit -> reply, observed into a PER-THREAD bounded
+  :class:`~harp_tpu.utils.metrics.TimerReservoir` (reservoir adds are
+  unsynchronized read-modify-writes, so threads never share one) and
+  merged serially after the join; the row's p50/p99 come from
+  ``Metrics.timing()`` — the same percentile surface the straggler
+  reports use (one latency format, ISSUE 10 satellite);
+* a warmup pass first touches every (endpoint, bucket) the run can reach,
+  so compile time never pollutes a latency sample (the endpoints hold ONE
+  resident compiled dispatch per bucket — ``trace_counts`` rides in the
+  row as proof no retrace happened mid-run).
+
+When telemetry is active (``HARP_TELEMETRY_DIR`` / ``telemetry.configure``),
+each mix row is also published into ``steps.jsonl`` via
+:func:`harp_tpu.telemetry.record_timing` (``kind: "timing"`` events), and
+the batcher's occupancy/batch-size gauges land in the shared metrics
+registry.
+
+Latency on a CPU-mesh session prices the ROUTER + BATCHER + dispatch stack
+with CPU dispatch times; the driver's on-chip ``bench.py --only serving``
+re-measures with real TPU dispatches (the row carries ``device`` so the two
+never get confused).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+# mix name -> fraction of requests that are top-k (the rest classify)
+DEFAULT_MIXES: Dict[str, float] = {
+    "topk_heavy": 0.8,
+    "classify_heavy": 0.2,
+    "mixed": 0.5,
+}
+
+CLASSIFY_MODEL = "classify"
+TOPK_MODEL = "topk"
+
+
+def build_gang(session, *, num_users: int = 512, num_items: int = 256,
+               rank: int = 8, k: int = 10, classify_dim: int = 16,
+               num_classes: int = 3, max_wait_s: float = 0.002,
+               seed: int = 0, metrics=None):
+    """A 2-worker serving gang over synthetic trained state.
+
+    Returns ``(workers, make_client, meta)`` — ``meta`` carries the
+    id/feature spaces the load threads draw from. Factors are random
+    (serving cost does not depend on their values); the tier-1 parity tests
+    in tests/test_serve.py cover correctness against fitted models.
+    """
+    from harp_tpu.models import nn
+    from harp_tpu.serve import (TopKEndpoint, classify_from_nn, local_gang)
+
+    rng = np.random.default_rng(seed)
+    model = nn.MLPClassifier(session, nn.NNConfig(
+        layers=(32,), num_classes=num_classes))
+    model.params = nn.init_params((classify_dim, 32, num_classes), seed=seed)
+    ep_classify = classify_from_nn(session, model, name=CLASSIFY_MODEL)
+    user_factors = rng.normal(size=(num_users, rank)).astype(np.float32)
+    item_factors = rng.normal(size=(num_items, rank)).astype(np.float32)
+    ep_topk = TopKEndpoint(session, TOPK_MODEL, user_factors, item_factors,
+                           k=k)
+    workers, make_client = local_gang(
+        session, [{CLASSIFY_MODEL: ep_classify}, {TOPK_MODEL: ep_topk}],
+        max_wait_s=max_wait_s, metrics=metrics)
+    meta = {"num_users": num_users, "num_items": num_items, "rank": rank,
+            "k": k, "classify_dim": classify_dim,
+            "endpoints": {CLASSIFY_MODEL: ep_classify, TOPK_MODEL: ep_topk}}
+    return workers, make_client, meta
+
+
+def _client_loop(client, n_requests: int, topk_fraction: float, meta: dict,
+                 seed: int, metrics, timer_name: str, errors: list,
+                 barrier: threading.Barrier, timeout: float) -> None:
+    rng = np.random.default_rng(seed)
+    from harp_tpu.serve import OP_CLASSIFY, OP_TOPK
+
+    barrier.wait()
+    for _ in range(n_requests):
+        is_topk = rng.random() < topk_fraction
+        if is_topk:
+            data = int(rng.integers(0, meta["num_users"]))
+            op, model = OP_TOPK, TOPK_MODEL
+        else:
+            data = rng.normal(size=(meta["classify_dim"],)).astype(
+                np.float32)
+            op, model = OP_CLASSIFY, CLASSIFY_MODEL
+        t0 = time.perf_counter()
+        try:
+            client.request(op, model, data, timeout=timeout)
+        except Exception as e:
+            # the load thread records ANY per-request failure (ServeError,
+            # timeout, transport error) and keeps the mix running; failures
+            # surface via the row's errors count, not by killing the
+            # generator mid-measurement
+            errors.append(f"{op}: {type(e).__name__}: {e}")
+            continue
+        metrics.observe(timer_name, time.perf_counter() - t0)
+
+
+def measure(session=None, *, requests_per_mix: int = 900,
+            num_clients: int = 3, mixes: Optional[Dict[str, float]] = None,
+            max_wait_s: float = 0.002, request_timeout: float = 60.0,
+            seed: int = 0) -> dict:
+    """Run every mix; returns the bench row (see module docstring)."""
+    import jax
+
+    from harp_tpu import telemetry
+    from harp_tpu.serve import OP_CLASSIFY, OP_TOPK
+    from harp_tpu.utils.metrics import Metrics
+
+    if session is None:
+        from harp_tpu.session import HarpSession
+
+        session = HarpSession()
+    mixes = dict(DEFAULT_MIXES if mixes is None else mixes)
+    metrics = Metrics()          # fresh registry: reservoirs are per-run
+    workers, make_client, meta = build_gang(
+        session, max_wait_s=max_wait_s, metrics=metrics, seed=seed)
+    clients = [make_client() for _ in range(num_clients)]
+    rows: Dict[str, dict] = {}
+    try:
+        # warmup, two layers: (1) compile EVERY bucket a closed loop of
+        # `num_clients` in-flight requests can reach — batches coalesce up
+        # to num_clients, so on a narrow mesh (bucket_sizes start at W)
+        # that can span several buckets, and a compile inside the measured
+        # loop would pollute a latency sample; (2) one request per
+        # (client, op) through the gang so the p2p connections and reply
+        # paths are established too
+        for name, ep in meta["endpoints"].items():
+            top = ep.bucket_for(min(num_clients, ep.max_batch))
+            for bucket in ep.bucket_sizes:
+                if bucket > top:
+                    break
+                if name == TOPK_MODEL:
+                    ep.dispatch(np.zeros(bucket, np.int64))
+                else:
+                    ep.dispatch(np.zeros(
+                        (bucket, meta["classify_dim"]), np.float32))
+        for c in clients:
+            c.request(OP_TOPK, TOPK_MODEL, 0, timeout=request_timeout)
+            c.request(OP_CLASSIFY, CLASSIFY_MODEL,
+                      np.zeros(meta["classify_dim"], np.float32),
+                      timeout=request_timeout)
+        for mix, frac in mixes.items():
+            timer = f"serve.latency.{mix}"
+            per_client = max(1, requests_per_mix // num_clients)
+            errors: list = []
+            barrier = threading.Barrier(num_clients + 1)
+            # one registry PER CLIENT THREAD: TimerReservoir.add is a
+            # read-modify-write with no lock, so concurrent observes into
+            # one shared reservoir can lose samples and undercount the
+            # row's request count — threads record privately and the
+            # reservoirs merge serially after the join
+            thread_regs = [Metrics() for _ in clients]
+            threads = [threading.Thread(
+                target=_client_loop,
+                args=(c, per_client, frac, meta, seed + 100 + i,
+                      thread_regs[i], timer, errors, barrier,
+                      request_timeout),
+                name=f"harp-serve-load-{mix}-{i}", daemon=True)
+                for i, c in enumerate(clients)]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            done = 0
+            for reg in thread_regs:
+                tr = reg.timers.get(timer)
+                if tr is None:
+                    continue
+                done += tr.count          # exact, even past the sample cap
+                for v in tr.samples:
+                    metrics.observe(timer, v)
+            timing = metrics.timing(timer)
+            rows[mix] = {
+                "topk_fraction": frac,
+                "requests": done,
+                "errors": len(errors),
+                "error_sample": errors[:3],
+                "qps": round(done / wall, 1) if wall > 0 else None,
+                "p50_ms": round(timing["p50_s"] * 1e3, 3) if timing else None,
+                "p99_ms": round(timing["p99_s"] * 1e3, 3) if timing else None,
+                "mean_ms": round(timing["mean_s"] * 1e3, 3) if timing
+                else None,
+            }
+            # one latency format (ISSUE 10 satellite): the same timing()
+            # dict the straggler report rows carry, into steps.jsonl
+            telemetry.record_timing(timer, metrics=metrics,
+                                    extra={"mix": mix,
+                                           "qps": rows[mix]["qps"]})
+            metrics.gauge(f"serve.qps.{mix}", rows[mix]["qps"] or 0.0)
+        occupancy = {}
+        for name in (CLASSIFY_MODEL, TOPK_MODEL):
+            batch_t = metrics.timing(f"serve.batch.{name}")
+            occupancy[name] = {
+                "mean_batch": round(batch_t["mean_s"], 2) if batch_t
+                else None,
+                "dispatches": batch_t.get("count", 0) if batch_t else 0,
+                "trace_counts": dict(
+                    meta["endpoints"][name].trace_counts),
+            }
+    finally:
+        for c in clients:
+            c.close()
+        for w in workers:
+            w.close()
+    device = ("tpu" if any(d.platform == "tpu" for d in jax.devices())
+              else jax.devices()[0].platform)
+    row = {
+        "gang": f"2 workers + {num_clients} closed-loop clients, "
+                f"loopback authenticated p2p, max_wait_s={max_wait_s}",
+        "device": device,
+        "mixes": rows,
+        "batching": occupancy,
+    }
+    if device != "tpu":
+        row["note"] = (
+            f"{device}-mesh session: latency prices the router + "
+            f"micro-batcher + {device} dispatch stack; the driver's "
+            f"on-chip `bench.py --only serving` re-measures with real TPU "
+            f"dispatches (same schema, device='tpu')")
+    return row

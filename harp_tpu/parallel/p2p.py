@@ -307,6 +307,24 @@ class P2PTransport:
     # send side (SyncClient/ConnPool parity)
     # ------------------------------------------------------------------ #
 
+    def add_peer(self, dest: int, address: Tuple[str, int]) -> None:
+        """Register (or refresh) a peer address outside the constructor —
+        the serving reply path: a worker learns each client's address from
+        the request frame's ``reply_to`` instead of a pre-shared map. A
+        changed address drops the stale pooled connection so the next send
+        dials the new endpoint."""
+        address = (address[0], int(address[1]))
+        with self._lock:
+            if self._peers.get(dest) == address:
+                return
+            self._peers[dest] = address
+            stale = self._conns.pop(dest, None)
+        if stale is not None:
+            try:
+                stale.close()
+            except OSError:
+                pass
+
     def _resolve(self, dest: int) -> Tuple[str, int]:
         with self._lock:
             if dest in self._peers:

@@ -1,0 +1,62 @@
+"""Online serving — the traffic-bearing face of the trained models.
+
+The reference stopped at batch fit/predict (every launcher ran one
+map-collective job and exited); the ROADMAP north star says "heavy traffic
+from millions of users". This package is that execution shape: a RESIDENT
+online service instead of a batch job, built from the same primitives the
+trainers use —
+
+* :mod:`~harp_tpu.serve.router` — an async request router riding the
+  existing authenticated p2p/events control plane
+  (``parallel/p2p.py``, ``parallel/events.py``): clients submit point
+  queries, the router fans each to the worker that owns the model, replies
+  travel point-to-point back to the requesting client (no gang-wide call
+  anywhere on the request path).
+* :mod:`~harp_tpu.serve.batcher` — continuous micro-batching: in-flight
+  requests coalesce (deadline- and size-bounded) into ONE resident jitted
+  predict dispatch per (model, batch-bucket) — static bucket shapes, donated
+  query buffers, zero per-request retrace. The jaxlint trace targets
+  ``serve_classify_nn`` / ``serve_topk_mf`` pin the dispatch programs in
+  ``tools/collective_budget.json`` (JL201/JL203), so a collective sneaking
+  into the classify dispatch or a retrace-shaped cache regression fails CI
+  exactly like a training-step drift.
+* :mod:`~harp_tpu.serve.endpoints` — the resident model surfaces:
+  classification endpoints for SVM / forest / NN ``predict`` (replicated
+  parameters, sharded query batch, zero collectives), and recsys **top-k**
+  served straight from the keyval push-pull machinery: SGD-MF/ALS user
+  factors live in a mesh-sharded :class:`~harp_tpu.keyval.DistributedKV`
+  (owner = ``id mod W``) and each dispatch routes the query ids to their
+  owners and back through the same ``bucket_route``/``route_back``
+  all_to_alls the parameter-server ops use.
+
+Serving state follows the SNIPPETS.md flax-partitioner pattern: shapes are
+resolved once, the sharding-annotated compiled fn stays resident, and every
+subsequent request is a pure dispatch. The DrJAX framing (arXiv:2403.07128)
+holds too: the serve step is a single traced program over the same mesh
+primitives as the trainers — which is exactly what lets the jaxpr budget
+engine police it.
+
+Load generation lives in :mod:`harp_tpu.benchmark.serving_load`
+(``bench.py --only serving``): p50/p99 latency + QPS at >=3 traffic mixes,
+published through :mod:`harp_tpu.telemetry`.
+"""
+
+from __future__ import annotations
+
+from harp_tpu.serve.batcher import MicroBatcher
+from harp_tpu.serve.endpoints import (ClassifyEndpoint, Endpoint,
+                                      TopKEndpoint, classify_from_forest,
+                                      classify_from_linear_svm,
+                                      classify_from_multiclass_svm,
+                                      classify_from_nn)
+from harp_tpu.serve.protocol import (OP_CLASSIFY, OP_TOPK, ServeError,
+                                     make_reply, make_request)
+from harp_tpu.serve.router import RouterClient, ServeWorker, local_gang
+
+__all__ = [
+    "ClassifyEndpoint", "Endpoint", "MicroBatcher", "OP_CLASSIFY", "OP_TOPK",
+    "RouterClient", "ServeError", "ServeWorker", "TopKEndpoint",
+    "classify_from_forest", "classify_from_linear_svm",
+    "classify_from_multiclass_svm", "classify_from_nn", "local_gang",
+    "make_reply", "make_request",
+]

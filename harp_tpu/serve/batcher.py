@@ -1,0 +1,184 @@
+"""Continuous micro-batching — deadline- and size-bounded coalescing.
+
+One batcher per served model. Point queries land in a pending deque; the
+batcher thread coalesces them into one endpoint dispatch per wake-up under
+two bounds:
+
+* **size** — the batch closes the moment ``max_batch`` (the endpoint's
+  largest bucket) requests are waiting: a full bucket never waits.
+* **deadline** — an underfull batch closes ``max_wait_s`` after its OLDEST
+  request arrived: latency is bounded by one coalescing window + one
+  dispatch, regardless of traffic.
+
+The dispatch itself is the endpoint's resident compiled fn for the chosen
+bucket (``endpoints.py``) — so the batcher adds exactly zero compiles: all
+batch sizes in ``(prev_bucket, bucket]`` share one trace.
+
+Shutdown contract (the PR 7 atexit-close contract extended to serving):
+``drain_and_stop`` refuses new submissions, serves everything already
+accepted (the in-flight micro-batch drains), then joins the thread. The
+router replies "shutting-down" to anything refused.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from harp_tpu.serve import protocol
+
+DEFAULT_MAX_WAIT_S = 0.002       # coalescing window: ~the latency floor a
+#                                  2 ms SLA-budget router can afford to spend
+#                                  waiting for batch-mates
+
+
+class MicroBatcher:
+    """Coalesce point queries for ONE endpoint into bucketed dispatches.
+
+    ``reply_fn(request_msg, ok, result=, error=, batch=, bucket=)`` is the
+    router's reply path; it must be thread-safe (the batcher thread calls
+    it).
+    """
+
+    def __init__(self, endpoint, reply_fn: Callable, *,
+                 max_wait_s: float = DEFAULT_MAX_WAIT_S,
+                 max_batch: Optional[int] = None, metrics=None):
+        if metrics is None:
+            from harp_tpu.utils.metrics import DEFAULT as metrics
+        self.endpoint = endpoint
+        self.reply_fn = reply_fn
+        self.max_wait_s = max_wait_s
+        self.max_batch = min(max_batch or endpoint.max_batch,
+                             endpoint.max_batch)
+        self.metrics = metrics
+        self._pending: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+        self._stopping = False
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"harp-serve-batcher-{endpoint.name}")
+        self._thread.start()
+
+    def pending(self) -> int:
+        with self._cv:
+            return len(self._pending)
+
+    def submit(self, msg: dict) -> bool:
+        """Accept one request for coalescing; False once stopping (the
+        caller replies shutting-down)."""
+        with self._cv:
+            if self._stopping:
+                return False
+            self._pending.append((msg, time.perf_counter()))
+            self._cv.notify()
+        return True
+
+    # ------------------------------------------------------------------ #
+
+    def _loop(self) -> None:
+        try:
+            while True:
+                with self._cv:
+                    while not self._pending:
+                        if self._stopping:
+                            return
+                        self._cv.wait(0.05)
+                    # coalesce: close on max_batch, or max_wait_s after the
+                    # oldest arrival (draining closes immediately — the
+                    # in-flight batch must not wait out its window)
+                    t_oldest = self._pending[0][1]
+                    while (len(self._pending) < self.max_batch
+                           and not self._stopping):
+                        remaining = self.max_wait_s - (time.perf_counter()
+                                                       - t_oldest)
+                        if remaining <= 0:
+                            break
+                        self._cv.wait(remaining)
+                    take = [self._pending.popleft()
+                            for _ in range(min(len(self._pending),
+                                               self.max_batch))]
+                self._dispatch(take)
+        finally:
+            self._stopped.set()
+
+    def _safe_reply(self, msg: dict, **kw) -> None:
+        try:
+            self.reply_fn(msg, **kw)
+        except Exception:
+            # a reply-path failure (a reply_to that slipped the router's
+            # guard, a transport edge case) must cost exactly ONE reply —
+            # never the batcher thread or the rest of a served batch's
+            # replies; the failure is logged and counted
+            import logging
+
+            logging.getLogger("harp_tpu.serve").exception(
+                "reply failed for request %s", msg.get("id"))
+            self.metrics.count(f"serve.reply_errors.{self.endpoint.name}")
+
+    def _dispatch(self, entries) -> None:
+        msgs = [m for m, _t in entries]
+        live, expired = [], []
+        now = time.time()
+        for m in msgs:
+            dl = m.get("deadline_ts")
+            (expired if dl is not None and now > dl else live).append(m)
+        for m in expired:
+            self._safe_reply(m, ok=False, error=protocol.ERR_DEADLINE)
+            self.metrics.count(f"serve.deadline_expired.{self.endpoint.name}")
+        # per-request admission BEFORE coalescing: one mismatched op or
+        # malformed payload costs that one request a clean error — its
+        # innocent batch-mates still dispatch
+        admitted = []
+        for m in live:
+            err = self.endpoint.validate_query(m.get("op"), m.get("data"))
+            if err is None:
+                admitted.append(m)
+            else:
+                self._safe_reply(m, ok=False,
+                                 error=f"{protocol.ERR_DISPATCH}: {err}")
+                self.metrics.count(
+                    f"serve.rejected_requests.{self.endpoint.name}")
+        live = admitted
+        if not live:
+            return
+        t0 = time.perf_counter()
+        try:
+            batch = np.asarray([m["data"] for m in live])
+            results = self.endpoint.dispatch(batch)
+        except Exception as e:
+            # a malformed query payload (wrong dtype/shape/range) can raise
+            # anything from the stack below; the serving loop must reply
+            # dispatch-error and keep serving, never die mid-traffic
+            for m in live:
+                self._safe_reply(m, ok=False,
+                                 error=f"{protocol.ERR_DISPATCH}: {e}")
+            self.metrics.count(f"serve.dispatch_errors.{self.endpoint.name}")
+            return
+        wall = time.perf_counter() - t0
+        n = len(live)
+        bucket = self.endpoint.bucket_for(n)
+        self.metrics.observe(f"serve.dispatch.{self.endpoint.name}", wall)
+        self.metrics.observe(f"serve.batch.{self.endpoint.name}", float(n))
+        self.metrics.gauge(f"serve.occupancy.{self.endpoint.name}",
+                           n / bucket)
+        self.metrics.count(f"serve.served.{self.endpoint.name}", n)
+        for m, res in zip(live, results):
+            self._safe_reply(m, ok=True, result=res, batch=n, bucket=bucket)
+
+    # ------------------------------------------------------------------ #
+
+    def drain_and_stop(self, timeout: float = 30.0) -> None:
+        """Refuse new work, serve everything already accepted, stop."""
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+        if not self._stopped.wait(timeout):
+            raise TimeoutError(
+                f"batcher {self.endpoint.name!r} failed to drain within "
+                f"{timeout}s ({self.pending()} pending)")
+        self._thread.join(timeout)

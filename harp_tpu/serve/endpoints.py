@@ -1,0 +1,406 @@
+"""Resident serving endpoints — one compiled predict dispatch per
+(model, batch-bucket).
+
+The serving analog of the SNIPPETS.md flax-partitioner pattern: all shapes
+and shardings are resolved ONCE (model parameters device-placed replicated,
+the sharded factor store scattered over the mesh), the compiled dispatch for
+each static batch bucket is built lazily and held in a cache container
+(``self._fns[bucket] = session.spmd(...)`` — the JL103-clean idiom), and
+every request after that is a pure dispatch: no retrace, no re-placement.
+Query buffers are DONATED (``donate_argnums`` on the batch argument) so XLA
+reuses the incoming bucket buffer instead of allocating per dispatch.
+
+Two endpoint families:
+
+* :class:`ClassifyEndpoint` — SVM / forest / NN ``predict`` with REPLICATED
+  parameters and the query batch SHARDED over workers: embarrassingly
+  parallel, ZERO collectives in the dispatch (pinned by the
+  ``serve_classify_nn`` jaxlint trace target — a collective sneaking in
+  fails JL201).
+* :class:`TopKEndpoint` — recsys top-k over SGD-MF/ALS factors, served
+  straight from the keyval push-pull machinery: user factors live in a
+  mesh-sharded :class:`~harp_tpu.keyval.DistributedKV` (owner =
+  ``id mod W``), each dispatch routes its query ids to their owners and
+  back through the SAME ``bucket_route``/``route_back`` all_to_alls the
+  parameter-server ops use, then scores against the replicated item factors
+  and takes ``lax.top_k`` locally. The ``serve_topk_mf`` trace target pins
+  exactly those 3 all_to_alls.
+
+Batch buckets are static shapes (multiples of the mesh width so the sharded
+query splits evenly); the micro-batcher picks the smallest bucket that fits
+the coalesced batch. ``trace_counts`` counts actual traces per bucket
+(incremented inside the traced body, so it ticks exactly when XLA retraces)
+— the tier-1 acceptance test asserts exactly one compile per
+(model, bucket).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from harp_tpu import keyval
+from harp_tpu.session import HarpSession
+
+
+class Endpoint:
+    """Base: bucket bookkeeping + the resident compiled-dispatch cache."""
+
+    op: str = ""
+
+    def __init__(self, session: HarpSession, name: str,
+                 bucket_sizes: Optional[Sequence[int]] = None):
+        self.session = session
+        self.name = name
+        w = session.num_workers
+        if bucket_sizes is None:
+            bucket_sizes = tuple(m * w for m in (1, 4, 16))
+        sizes = tuple(sorted(int(b) for b in bucket_sizes))
+        for b in sizes:
+            if b <= 0 or b % w:
+                raise ValueError(
+                    f"bucket sizes must be positive multiples of the mesh "
+                    f"width {w} (the sharded query batch must split "
+                    f"evenly); got {sizes}")
+        self.bucket_sizes = sizes
+        self._fns: Dict[int, object] = {}        # bucket -> compiled dispatch
+        self.trace_counts: Dict[int, int] = {}   # bucket -> actual traces
+        self._state: tuple = ()                  # resident device args
+
+    @property
+    def max_batch(self) -> int:
+        return self.bucket_sizes[-1]
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.bucket_sizes:
+            if n <= b:
+                return b
+        raise ValueError(f"batch of {n} exceeds the largest bucket "
+                         f"{self.max_batch} (the batcher caps batches at "
+                         f"max_batch; direct callers must too)")
+
+    def validate_query(self, op, data) -> Optional[str]:
+        """Cheap per-request admission check, run BEFORE coalescing: one
+        stale-placement or malformed request must cost that one request a
+        clean error, never fail its innocent batch-mates' dispatch. Returns
+        an error string or None."""
+        if op != self.op:
+            return (f"op {op!r} does not match endpoint {self.name!r} "
+                    f"(op {self.op!r}) — stale placement?")
+        return self._validate_data(data)
+
+    def _validate_data(self, data) -> Optional[str]:
+        return None
+
+    def _count_trace(self, bucket: int) -> None:
+        # runs at TRACE time only (Python side effect inside the traced
+        # body): the counter ticks exactly when XLA (re)traces this bucket
+        self.trace_counts[bucket] = self.trace_counts.get(bucket, 0) + 1
+
+    def compiled(self, bucket: int):
+        if bucket not in self._fns:
+            if bucket not in self.bucket_sizes:
+                raise ValueError(f"{bucket} is not a configured bucket "
+                                 f"{self.bucket_sizes}")
+            self._fns[bucket] = self._build(bucket)
+        return self._fns[bucket]
+
+    def _build(self, bucket: int):
+        raise NotImplementedError
+
+    def _place_query(self, batch: np.ndarray, bucket: int):
+        raise NotImplementedError
+
+    def prepared(self, batch) -> Tuple[object, tuple, int, int]:
+        """(compiled fn, full arg tuple, n, bucket) for a request batch —
+        the dispatch surface, also what the jaxlint trace target traces."""
+        n = len(batch)
+        bucket = self.bucket_for(n)
+        fn = self.compiled(bucket)
+        return fn, self._state + (self._place_query(batch, bucket),), n, \
+            bucket
+
+    def dispatch(self, batch) -> List:
+        """Serve one coalesced batch; returns one result per input row."""
+        fn, args, n, _bucket = self.prepared(batch)
+        return self._unpack(fn(*args), n)
+
+    def _unpack(self, out, n: int) -> List:
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------------------- #
+# Classification (SVM / forest / NN predict) — zero-collective dispatch
+# --------------------------------------------------------------------------- #
+
+class ClassifyEndpoint(Endpoint):
+    """Resident classifier: replicated params, sharded query batch.
+
+    ``predict_fn(params, x_local) -> (n_local,) int32 class positions`` must
+    be collective-free (the trace target pins zero); ``classes`` maps
+    positions back to the model's label space (None = positions ARE the
+    labels).
+    """
+
+    op = "classify"
+
+    def __init__(self, session: HarpSession, name: str, predict_fn, params,
+                 classes: Optional[np.ndarray] = None, dim: Optional[int] = None,
+                 bucket_sizes: Optional[Sequence[int]] = None):
+        super().__init__(session, name, bucket_sizes)
+        self._predict = predict_fn
+        self._params = jax.device_put(
+            params, session.sharding(session.replicate()))
+        self.classes = None if classes is None else np.asarray(classes)
+        self.dim = dim
+        self._state = (self._params,)
+
+    def _validate_data(self, data) -> Optional[str]:
+        shape = np.shape(data)
+        if len(shape) != 1 or (self.dim is not None
+                               and shape[0] != self.dim):
+            want = f"({self.dim},)" if self.dim is not None else "(d,)"
+            return (f"classify query must be one {want} feature vector, "
+                    f"got shape {shape}")
+        return None
+
+    def _build(self, bucket: int):
+        sess = self.session
+
+        def predict(params, x):
+            self._count_trace(bucket)
+            return self._predict(params, x)
+
+        return sess.spmd(predict,
+                         in_specs=(sess.replicate(), sess.shard()),
+                         out_specs=sess.shard(),
+                         donate_argnums=(1,))
+
+    def _place_query(self, batch: np.ndarray, bucket: int):
+        batch = np.asarray(batch, np.float32)
+        xb = np.zeros((bucket,) + batch.shape[1:], np.float32)
+        xb[: len(batch)] = batch
+        return self.session.scatter(jnp.asarray(xb))
+
+    def _unpack(self, out, n: int) -> List:
+        idx = np.asarray(out)[:n]
+        if self.classes is not None:
+            idx = self.classes[idx]
+        return [i.item() for i in idx]
+
+
+def classify_from_nn(session: HarpSession, model,
+                     name: str = "nn", **kw) -> ClassifyEndpoint:
+    """Resident :class:`~harp_tpu.models.nn.MLPClassifier` predict."""
+    from harp_tpu.models import nn
+
+    params = [(jnp.asarray(w), jnp.asarray(b)) for w, b in model.params]
+
+    def predict(p, x):
+        return jnp.argmax(nn.forward(p, x), axis=-1).astype(jnp.int32)
+
+    return ClassifyEndpoint(session, name, predict, params,
+                            dim=int(params[0][0].shape[0]), **kw)
+
+
+def classify_from_linear_svm(session: HarpSession, model,
+                             name: str = "svm", **kw) -> ClassifyEndpoint:
+    """Resident :class:`~harp_tpu.models.svm.LinearSVM` predict."""
+    params = (jnp.asarray(model.w, jnp.float32),
+              jnp.asarray(model.b, jnp.float32))
+
+    def predict(p, x):
+        w, b = p
+        return (x @ w + b >= 0.0).astype(jnp.int32)
+
+    return ClassifyEndpoint(session, name, predict, params,
+                            dim=int(model.w.shape[0]), **kw)
+
+
+def classify_from_multiclass_svm(session: HarpSession, model,
+                                 name: str = "svm", **kw) -> ClassifyEndpoint:
+    """Resident :class:`~harp_tpu.models.svm.MultiClassSVM` predict (the
+    one-vs-one max-wins vote, same tie convention as ``_ovo_votes_jit``:
+    argmax picks the first maximum = the smaller class position)."""
+    from harp_tpu.models import svm as svm_mod
+
+    if model._pack is None:
+        raise ValueError("MultiClassSVM must be fitted (with >=2 classes) "
+                         "before serving")
+    cfg = model.config
+    n_classes = len(model.classes_)
+    params = tuple(model._pack)          # (sv_pad, coef_pad, pos_i, pos_j)
+
+    def predict(p, x):
+        sv, coef, pos_i, pos_j = p
+        df = jax.vmap(
+            lambda s, c: (svm_mod._gram(cfg, x, s) + 1.0) @ c)(sv, coef)
+        win_i = (df >= 0.0)[..., None]
+        votes = (jax.nn.one_hot(pos_i, n_classes)[:, None, :] * win_i
+                 + jax.nn.one_hot(pos_j, n_classes)[:, None, :]
+                 * (1.0 - win_i)).sum(axis=0)
+        return jnp.argmax(votes, axis=1).astype(jnp.int32)
+
+    return ClassifyEndpoint(session, name, predict, params,
+                            classes=model.classes_,
+                            dim=int(params[0].shape[-1]), **kw)
+
+
+def classify_from_forest(session: HarpSession, model,
+                         name: str = "forest", **kw) -> ClassifyEndpoint:
+    """Resident :class:`~harp_tpu.models.forest.RandomForest` /
+    ``DecisionTree`` predict — the host-numpy tree walk rebuilt as a device
+    program (static-depth gather walk, vmapped over trees, one-hot vote),
+    including the feature binning (per-column ``searchsorted`` against the
+    fitted quantile edges)."""
+    if model.tree is None:
+        raise ValueError("forest must be fitted before serving")
+    feats, sbins, leaf_class = model.tree
+    if feats.ndim == 1:                  # single DecisionTree -> 1-tree forest
+        feats, sbins, leaf_class = (feats[None], sbins[None],
+                                    leaf_class[None])
+    depth = model.config.depth
+    num_classes = model.config.num_classes
+    params = (jnp.asarray(feats), jnp.asarray(sbins),
+              jnp.asarray(leaf_class), jnp.asarray(model.edges, jnp.float32))
+
+    def predict(p, x):
+        f, sb, leaf, edges = p
+        bins = jax.vmap(
+            lambda e, col: jnp.searchsorted(e, col, side="right"),
+            in_axes=(0, 1), out_axes=1)(edges, x).astype(jnp.int32)
+
+        def one_tree(f_t, sb_t, leaf_t):
+            a = jnp.zeros(bins.shape[0], jnp.int32)
+            off = 0
+            for level in range(depth):      # static depth: unrolled walk
+                idx = off + a
+                chosen = jnp.take_along_axis(
+                    bins, f_t[idx][:, None], axis=1)[:, 0]
+                a = a * 2 + (chosen > sb_t[idx]).astype(jnp.int32)
+                off += 2 ** level
+            return leaf_t[a]
+
+        preds = jax.vmap(one_tree)(f, sb, leaf)          # (trees, n_local)
+        votes = jax.nn.one_hot(preds, num_classes).sum(axis=0)
+        return jnp.argmax(votes, axis=1).astype(jnp.int32)
+
+    return ClassifyEndpoint(session, name, predict, params,
+                            dim=int(model.edges.shape[0]), **kw)
+
+
+# --------------------------------------------------------------------------- #
+# Recsys top-k — sharded factor lookup through the keyval push-pull ops
+# --------------------------------------------------------------------------- #
+
+class TopKEndpoint(Endpoint):
+    """Top-k recommendation from factor matrices (SGD-MF / ALS output).
+
+    User factors are sharded over the mesh as a
+    :class:`~harp_tpu.keyval.DistributedKV` (owner = ``id mod W``, sorted
+    dense per-worker stores); item factors are replicated. A dispatch takes
+    a bucket of query ids SHARDED over workers, routes each id to its
+    owning worker and the factor row back (``DistributedKV.lookup`` =
+    ``bucket_route`` + ``route_back``, 3 all_to_alls — the exact
+    parameter-server pull path), scores ``w_u @ H^T`` on the MXU and takes
+    ``lax.top_k`` locally. Unknown ids come back ``found=False`` with empty
+    recommendations, never a crash (``route_cap`` is the full local batch,
+    so owner skew can never overflow a routing bucket).
+    """
+
+    op = "topk"
+
+    def __init__(self, session: HarpSession, name: str, user_factors,
+                 item_factors, k: int = 10,
+                 user_ids: Optional[np.ndarray] = None,
+                 bucket_sizes: Optional[Sequence[int]] = None):
+        super().__init__(session, name, bucket_sizes)
+        uf = np.asarray(user_factors, np.float32)
+        items = np.asarray(item_factors, np.float32)
+        if uf.ndim != 2 or items.ndim != 2 or uf.shape[1] != items.shape[1]:
+            raise ValueError(
+                f"factor shapes must be (users, r) and (items, r); got "
+                f"{uf.shape} and {items.shape}")
+        ids = (np.arange(len(uf)) if user_ids is None
+               else np.asarray(user_ids))
+        if len(ids) != len(uf):
+            raise ValueError(f"{len(ids)} user ids for {len(uf)} factor rows")
+        if len(ids) and (ids.min() < 0 or ids.max() >= keyval.EMPTY):
+            raise ValueError(f"user ids must be in [0, {keyval.EMPTY})")
+        w = session.num_workers
+        owner = ids % w
+        counts = np.bincount(owner, minlength=w)
+        cap = max(int(counts.max()), 1)
+        keys = np.full((w, cap), keyval.EMPTY, np.int32)
+        vals = np.zeros((w, cap, uf.shape[1]), np.float32)
+        for wid in range(w):
+            mine = np.flatnonzero(owner == wid)
+            mine = mine[np.argsort(ids[mine], kind="stable")]
+            keys[wid, : len(mine)] = ids[mine]
+            vals[wid, : len(mine)] = uf[mine]
+        self.k = min(int(k), items.shape[0])
+        self.num_items = items.shape[0]
+        self._state = (session.scatter(keys), session.scatter(vals),
+                       session.scatter(counts.astype(np.int32)),
+                       session.replicate_put(items))
+
+    def _validate_data(self, data) -> Optional[str]:
+        if np.ndim(data) != 0:
+            return f"top-k query must be one scalar id, got shape " \
+                   f"{np.shape(data)}"
+        try:
+            uid = int(data)
+        except (TypeError, ValueError):
+            return f"top-k query id must be an integer, got {type(data)}"
+        if not 0 <= uid < keyval.EMPTY:
+            return f"top-k query id {uid} outside [0, {keyval.EMPTY})"
+        return None
+
+    def _build(self, bucket: int):
+        sess = self.session
+        k = self.k
+
+        def topk(keys, vals, count, items, q):
+            self._count_trace(bucket)
+            store = keyval.KVStore(keys[0], vals[0], count[0])
+            # the parameter-server pull: route ids to owners, factors back.
+            # route_cap = the full local batch — any owner skew fits.
+            w_q, found = keyval.DistributedKV(store).lookup(
+                q, route_cap=q.shape[0])
+            scores = jax.lax.dot_general(
+                w_q, items, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            scores = jnp.where(found[:, None], scores,
+                               jnp.finfo(jnp.float32).min)
+            top_v, top_i = jax.lax.top_k(scores, k)
+            return top_i.astype(jnp.int32), top_v, found
+
+        return sess.spmd(
+            topk,
+            in_specs=(sess.shard(), sess.shard(), sess.shard(),
+                      sess.replicate(), sess.shard()),
+            out_specs=(sess.shard(),) * 3,
+            donate_argnums=(4,))
+
+    def _place_query(self, batch, bucket: int):
+        ids = np.asarray(batch, np.int64)
+        if len(ids) and (ids.min() < 0 or ids.max() >= keyval.EMPTY):
+            raise ValueError(f"query ids must be in [0, {keyval.EMPTY})")
+        qb = np.full((bucket,), keyval.EMPTY, np.int32)
+        qb[: len(ids)] = ids.astype(np.int32)
+        return self.session.scatter(jnp.asarray(qb, jnp.int32))
+
+    def _unpack(self, out, n: int) -> List:
+        top_i, top_v, found = (np.asarray(o) for o in out)
+        rows = []
+        for i in range(n):
+            if found[i]:
+                rows.append({"found": True,
+                             "items": [int(j) for j in top_i[i]],
+                             "scores": [float(v) for v in top_v[i]]})
+            else:
+                rows.append({"found": False, "items": [], "scores": []})
+        return rows

@@ -1,0 +1,68 @@
+"""Serve wire schema — the request/reply frames on the p2p control plane.
+
+Frames are plain dicts (the p2p transport pickles payloads; plain dicts
+survive version skew between gang members better than pickled classes — the
+same reasoning as the telemetry JSONL events). Every request carries a
+``reply_to`` = ``(client_rank, host, port)`` triple so the serving worker
+can answer point-to-point without a pre-shared peer map
+(:meth:`harp_tpu.parallel.p2p.P2PTransport.add_peer`).
+
+Request::
+
+    {"kind": "serve.request", "id": "<rank>-<n>", "op": "topk"|"classify",
+     "model": "<name>", "data": <one query: (d,) features | scalar id>,
+     "reply_to": (rank, host, port), "ts": <epoch s>,
+     "deadline_ts": <epoch s or None>}
+
+Reply::
+
+    {"kind": "serve.reply", "id": ..., "ok": bool, "result": ...,
+     "error": None|"shutting-down: ..."|..., "served_by": rank,
+     "batch": n, "bucket": B}
+
+``batch``/``bucket`` expose the micro-batcher's coalescing (how many
+requests rode this dispatch, into which static bucket) — the load generator
+derives its occupancy stats from them without touching the server.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional, Tuple
+
+REQUEST = "serve.request"
+REPLY = "serve.reply"
+
+OP_TOPK = "topk"
+OP_CLASSIFY = "classify"
+
+# error strings (reply["error"] leads with one of these)
+ERR_SHUTTING_DOWN = "shutting-down"
+ERR_UNKNOWN_MODEL = "unknown-model"
+ERR_DISPATCH = "dispatch-error"
+ERR_DEADLINE = "deadline-exceeded"
+
+
+class ServeError(RuntimeError):
+    """A request-level failure reported by the serving gang (the reply's
+    ``error`` string is the message)."""
+
+
+def make_request(req_id: str, op: str, model: str, data: Any,
+                 reply_to: Tuple[int, str, int],
+                 deadline_ts: Optional[float] = None) -> dict:
+    if op not in (OP_TOPK, OP_CLASSIFY):
+        raise ValueError(f"op must be {OP_TOPK!r} or {OP_CLASSIFY!r}, "
+                         f"got {op!r}")
+    return {"kind": REQUEST, "id": req_id, "op": op, "model": model,
+            "data": data, "reply_to": tuple(reply_to),
+            "ts": time.time(), "deadline_ts": deadline_ts}
+
+
+def make_reply(request: dict, ok: bool, result: Any = None,
+               error: Optional[str] = None, served_by: Optional[int] = None,
+               batch: Optional[int] = None,
+               bucket: Optional[int] = None) -> dict:
+    return {"kind": REPLY, "id": request["id"], "ok": bool(ok),
+            "result": result, "error": error, "served_by": served_by,
+            "batch": batch, "bucket": bucket}

@@ -1,0 +1,403 @@
+"""Async request router on the authenticated p2p/events control plane.
+
+Topology: a serving gang of :class:`ServeWorker`\\ s (ranks ``0..S-1``),
+each owning a set of models (the ``placement`` map ``{model: rank}``), plus
+any number of :class:`RouterClient`\\ s on ranks ``>= S``. Every frame is a
+point-to-point :class:`~harp_tpu.parallel.p2p.P2PTransport` send — two
+processes touch each message, no gang-wide call anywhere on the request
+path (the reference's SyncClient/Server residual, now carrying traffic).
+
+Fan-out: a client submits to the model's owner directly when it knows the
+placement; a request landing on a non-owning worker is FORWARDED to the
+owner (one extra hop), with the original client's ``reply_to`` intact — the
+reply still travels owner→client directly. Workers learn client reply
+addresses from the request frames (``P2PTransport.add_peer``), so clients
+never pre-register.
+
+Shutdown (the PR 7 atexit-close contract extended to serve hooks):
+``begin_drain`` flips the worker to rejecting new requests with a clean
+"shutting-down" reply while the in-flight micro-batches drain;
+``close`` = drain + batcher stop + reader-thread join + transport close.
+Live workers and clients register in a module-level set closed at
+interpreter exit, so an abandoned serving gang never leaves orphan threads
+or listening sockets behind.
+"""
+
+from __future__ import annotations
+
+import atexit
+import itertools
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from harp_tpu.parallel.events import EventQueue
+from harp_tpu.parallel.p2p import P2PTransport
+from harp_tpu.serve import protocol
+from harp_tpu.serve.batcher import DEFAULT_MAX_WAIT_S, MicroBatcher
+
+_LIVE: "set" = set()          # live workers + clients, closed at exit
+_live_lock = threading.Lock()
+_atexit_installed = False
+
+
+def _register_live(obj) -> None:
+    global _atexit_installed
+    with _live_lock:
+        _LIVE.add(obj)
+        if not _atexit_installed:
+            atexit.register(_close_at_exit)
+            _atexit_installed = True
+
+
+def _unregister_live(obj) -> None:
+    with _live_lock:
+        _LIVE.discard(obj)
+
+
+def _close_at_exit() -> None:
+    # same contract as telemetry.step_log's atexit flush: a process exiting
+    # mid-serve must drain in-flight batches and release sockets/threads
+    import logging
+
+    with _live_lock:
+        live = list(_LIVE)
+    for obj in live:
+        try:
+            obj.close()
+        except Exception:
+            # one wedged worker (drain timeout, dead socket) must not skip
+            # closing the REST of the live set at interpreter exit — each
+            # object gets its close attempt, failures are logged
+            logging.getLogger("harp_tpu.serve").exception(
+                "atexit close failed for %r", obj)
+
+
+class ServeWorker:
+    """One serving gang member: transport + per-model micro-batchers."""
+
+    def __init__(self, session, rank: int, endpoints: Dict[str, object],
+                 placement: Dict[str, int], *,
+                 peers: Optional[Dict[int, Tuple[str, int]]] = None,
+                 secret: Optional[bytes] = None, host: str = "127.0.0.1",
+                 max_wait_s: float = DEFAULT_MAX_WAIT_S, metrics=None):
+        if metrics is None:
+            from harp_tpu.utils.metrics import DEFAULT as metrics
+        self.session = session
+        self.rank = rank
+        self.placement = dict(placement)
+        self.endpoints = dict(endpoints)
+        # gang ranks are reserved: a reply_to rank colliding with a serving
+        # worker must never overwrite the forwarding route to that worker
+        self._worker_ranks = set(self.placement.values()) | {rank}
+        self.metrics = metrics
+        self.queue = EventQueue()
+        self.transport = P2PTransport(self.queue, rank=rank,
+                                      peers=peers if peers is not None
+                                      else {},
+                                      secret=secret, host=host)
+        self.batchers: Dict[str, MicroBatcher] = {
+            name: MicroBatcher(ep, self._make_reply_fn(), metrics=metrics,
+                               max_wait_s=max_wait_s)
+            for name, ep in self.endpoints.items()}
+        self._draining = False
+        self._closed = False
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"harp-serve-worker-{rank}")
+        self._thread.start()
+        _register_live(self)
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.transport.address
+
+    # -- receive loop -------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            ev = self.queue.wait(timeout=0.05)
+            if ev is None:
+                continue
+            payload = ev.payload
+            if not (isinstance(payload, dict)
+                    and payload.get("kind") == protocol.REQUEST):
+                self.metrics.count("serve.non_request_events")
+                continue
+            try:
+                self._handle(payload)
+            except Exception:
+                # the receive thread is the worker's lifeline: a malformed
+                # frame (missing id, unhashable model — anything the typed
+                # guards below did not anticipate) costs that one frame,
+                # logged and counted, never the loop
+                import logging
+
+                logging.getLogger("harp_tpu.serve").exception(
+                    "dropping unhandlable request frame")
+                self.metrics.count("serve.malformed_requests")
+
+    def _handle(self, msg: dict) -> None:
+        self.metrics.count("serve.requests")
+        if self._draining:
+            self._reply(msg, ok=False, error=protocol.ERR_SHUTTING_DOWN)
+            return
+        model = msg.get("model")
+        owner = self.placement.get(model, self.rank)
+        if owner != self.rank:
+            # fan out to the owning worker; reply_to stays the client's, so
+            # the answer travels owner -> client directly
+            try:
+                self.transport.send(owner, msg)
+                self.metrics.count("serve.forwarded")
+            except (KeyError, ConnectionError) as e:
+                self._reply(msg, ok=False,
+                            error=f"forward to worker {owner} failed: {e}")
+            return
+        batcher = self.batchers.get(model)
+        if batcher is None:
+            self._reply(msg, ok=False,
+                        error=f"{protocol.ERR_UNKNOWN_MODEL}: {model!r} "
+                              f"(this worker serves "
+                              f"{sorted(self.endpoints)})")
+            return
+        if not batcher.submit(msg):
+            self._reply(msg, ok=False, error=protocol.ERR_SHUTTING_DOWN)
+
+    # -- reply path ---------------------------------------------------------
+
+    def _make_reply_fn(self) -> Callable:
+        def reply(msg, ok, result=None, error=None, batch=None, bucket=None):
+            self._reply(msg, ok=ok, result=result, error=error, batch=batch,
+                        bucket=bucket)
+        return reply
+
+    def _reply(self, msg: dict, ok: bool, result=None, error=None,
+               batch=None, bucket=None) -> None:
+        try:
+            rank, rhost, rport = msg["reply_to"]
+            rank, rport = int(rank), int(rport)
+        except (KeyError, TypeError, ValueError):
+            # malformed reply_to (wrong arity, non-numeric rank/port): the
+            # reply is unroutable, the serving thread must not die for it
+            self.metrics.count("serve.unroutable_replies")
+            return
+        if rank in self._worker_ranks:
+            # a client claiming a serving worker's rank would hijack the
+            # gang's forwarding route if we add_peer'd it — drop the reply
+            # (the client is misconfigured; local_gang mints client ranks
+            # past the gang) and count the collision loudly
+            self.metrics.count("serve.reply_rank_collisions")
+            return
+        self.transport.add_peer(rank, (rhost, rport))
+        try:
+            self.transport.send(rank, protocol.make_reply(
+                msg, ok=ok, result=result, error=error,
+                served_by=self.rank, batch=batch, bucket=bucket))
+        except (OSError, TypeError):
+            # client gone (closed/crashed between send and reply — OSError
+            # covers ConnectionError and gaierror) or a reply_to host of a
+            # nonsense type reaching the socket layer: count, keep serving
+            # — at-most-once is the transport's contract
+            self.metrics.count("serve.lost_replies")
+
+    # -- shutdown (atexit-close contract) -----------------------------------
+
+    def begin_drain(self) -> None:
+        """Stop ACCEPTING: from now on new requests get a clean
+        "shutting-down" reply while already-accepted batches finish."""
+        self._draining = True
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Drain in-flight micro-batches, stop threads, close the
+        transport. Idempotent. A drain timeout (wedged dispatch) still
+        releases the receive thread, socket, and live-set registration
+        before the TimeoutError propagates — close never leaves the worker
+        half-open and unretryable."""
+        if self._closed:
+            return
+        self._closed = True
+        self.begin_drain()
+        drain_errors = []
+        try:
+            # EVERY batcher gets its drain attempt — one wedged model must
+            # not leave another's accepted requests unanswered and its
+            # thread spinning against the soon-closed transport
+            for name, b in self.batchers.items():
+                try:
+                    b.drain_and_stop(timeout)
+                except TimeoutError as e:
+                    drain_errors.append(f"{name}: {e}")
+        finally:
+            self._stop.set()
+            self._thread.join(timeout)
+            self.transport.close()
+            _unregister_live(self)
+        if drain_errors:
+            raise TimeoutError("; ".join(drain_errors))
+
+    def __enter__(self) -> "ServeWorker":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class _PendingReply:
+    """A reply future: set by the client's receive thread."""
+
+    __slots__ = ("_event", "reply", "_discard")
+
+    def __init__(self, discard=None):
+        self._event = threading.Event()
+        self.reply: Optional[dict] = None
+        self._discard = discard
+
+    def _set(self, reply: dict) -> None:
+        self.reply = reply
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """The reply's ``result`` payload; raises
+        :class:`~harp_tpu.serve.protocol.ServeError` on a server-reported
+        error and ``TimeoutError`` when no reply arrives (peer gone or
+        frame lost — the transport is at-most-once, so treat a timeout as
+        'retry or fail', not 'bug'). A timed-out entry is dropped from the
+        client's waiting map — a resident client accumulating lost replies
+        must not grow that map without bound."""
+        if not self._event.wait(timeout):
+            if self._discard is not None:
+                self._discard()
+            raise TimeoutError("no reply within timeout")
+        if not self.reply["ok"]:
+            raise protocol.ServeError(self.reply.get("error") or "unknown")
+        return self.reply["result"]
+
+
+class RouterClient:
+    """Client-side endpoint: submits point queries, matches replies by id."""
+
+    def __init__(self, rank: int, peers: Dict[int, Tuple[str, int]],
+                 placement: Dict[str, int], *,
+                 secret: Optional[bytes] = None, host: str = "127.0.0.1",
+                 metrics=None):
+        if metrics is None:
+            from harp_tpu.utils.metrics import DEFAULT as metrics
+        self.rank = rank
+        self.placement = dict(placement)
+        self.metrics = metrics
+        self._default_dest = min(peers) if peers else 0
+        self.queue = EventQueue()
+        self.transport = P2PTransport(self.queue, rank=rank,
+                                      peers=dict(peers), secret=secret,
+                                      host=host)
+        self._waiting: Dict[str, _PendingReply] = {}
+        self._lock = threading.Lock()
+        self._ids = itertools.count()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._recv_loop, daemon=True,
+            name=f"harp-serve-client-{rank}")
+        self._thread.start()
+        self._closed = False
+        _register_live(self)
+
+    def _recv_loop(self) -> None:
+        while not self._stop.is_set():
+            ev = self.queue.wait(timeout=0.05)
+            if ev is None:
+                continue
+            payload = ev.payload
+            if not (isinstance(payload, dict)
+                    and payload.get("kind") == protocol.REPLY):
+                continue
+            with self._lock:
+                pending = self._waiting.pop(payload.get("id"), None)
+            if pending is not None:
+                pending._set(payload)
+
+    def submit(self, op: str, model: str, data, *,
+               deadline_ts: Optional[float] = None,
+               dest: Optional[int] = None) -> _PendingReply:
+        """Asynchronously submit one point query; returns the reply future.
+        ``dest`` overrides the placement-derived owner (tests exercise the
+        forwarding leg this way)."""
+        if self._closed:
+            raise ConnectionError("client is closed")
+        rid = f"{self.rank}-{next(self._ids)}"
+        if dest is None:
+            dest = self.placement.get(model, self._default_dest)
+        msg = protocol.make_request(
+            rid, op, model, data,
+            reply_to=(self.rank,) + tuple(self.transport.address),
+            deadline_ts=deadline_ts)
+
+        def discard(rid=rid):
+            with self._lock:
+                self._waiting.pop(rid, None)
+
+        pending = _PendingReply(discard=discard)
+        with self._lock:
+            self._waiting[rid] = pending
+        try:
+            self.transport.send(dest, msg)
+        except (KeyError, ConnectionError):
+            with self._lock:
+                self._waiting.pop(rid, None)
+            raise
+        return pending
+
+    def request(self, op: str, model: str, data, *, timeout: float = 30.0,
+                dest: Optional[int] = None):
+        """Synchronous point query (submit + wait)."""
+        return self.submit(op, model, data, dest=dest).result(timeout)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        self._thread.join(5.0)
+        self.transport.close()
+        _unregister_live(self)
+
+    def __enter__(self) -> "RouterClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def local_gang(session, worker_endpoints: List[Dict[str, object]], *,
+               secret: Optional[bytes] = b"harp-serve-local",
+               max_wait_s: float = DEFAULT_MAX_WAIT_S, metrics=None
+               ) -> Tuple[List[ServeWorker], Callable[[], RouterClient]]:
+    """An in-process serving gang on loopback (the tier-1/bench topology;
+    multi-host gangs pass explicit peer maps or KV rendezvous instead).
+
+    ``worker_endpoints[r]`` is worker ``r``'s ``{model: endpoint}`` map; the
+    placement is derived from it. Returns the workers plus a factory that
+    mints connected clients on fresh ranks. All transports authenticate
+    with ``secret`` and bind loopback only.
+    """
+    placement = {name: r for r, eps in enumerate(worker_endpoints)
+                 for name in eps}
+    workers = [ServeWorker(session, r, eps, placement, peers={},
+                           secret=secret, max_wait_s=max_wait_s,
+                           metrics=metrics)
+               for r, eps in enumerate(worker_endpoints)]
+    for w in workers:
+        for v in workers:
+            if v.rank != w.rank:
+                w.transport.add_peer(v.rank, v.address)
+    next_rank = itertools.count(len(workers))
+
+    def make_client() -> RouterClient:
+        return RouterClient(next(next_rank),
+                            {w.rank: w.address for w in workers},
+                            placement, secret=secret, metrics=metrics)
+
+    return workers, make_client

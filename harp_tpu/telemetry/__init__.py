@@ -43,12 +43,12 @@ from harp_tpu.telemetry.comm_ledger import (CommLedger, ledger_for,
 from harp_tpu.telemetry.gang import (gather_snapshots, publish_straggler_report,
                                      straggler_report)
 from harp_tpu.telemetry.step_log import (StepLog, active, configure, disable,
-                                         phase, record_chunk)
+                                         phase, record_chunk, record_timing)
 from harp_tpu.telemetry.xprof import XprofController, request_xprof
 
 __all__ = [
     "CommLedger", "StepLog", "XprofController", "active", "configure",
     "disable", "gather_snapshots", "ledger_for", "load_manifest",
     "manifest_target", "phase", "publish_straggler_report", "record_chunk",
-    "request_xprof", "straggler_report",
+    "record_timing", "request_xprof", "straggler_report",
 ]

@@ -241,6 +241,36 @@ def record_chunk(model: str, *, start: int,
     log.boundary()
 
 
+def record_timing(name: str, *, timer: Optional[str] = None,
+                  metrics=None, extra: Optional[Dict] = None) -> None:
+    """Surface a bounded-timer percentile snapshot into ``steps.jsonl``.
+
+    One event, ``kind: "timing"``, whose latency fields are EXACTLY
+    ``utils.metrics.Metrics.timing()`` output (count/total_s/mean_s/last_s/
+    p50_s/p90_s/p99_s) — the same schema the straggler report's per-rank
+    rows carry, so serving-bench latency rows and straggler reports share
+    one latency format instead of two drifting ones. ``timer`` names the
+    reservoir to snapshot (default: ``name``); ``metrics`` overrides the
+    registry (the serving load generator keeps per-mix registries so one
+    mix's reservoir never dilutes the next). No-op when telemetry is off or
+    the timer has no samples.
+    """
+    log = active()
+    if log is None:
+        return
+    reg = metrics if metrics is not None else log.metrics
+    t = reg.timing(timer or name)
+    if not t:
+        return
+    ev = {"v": EVENT_VERSION, "kind": "timing", "name": name,
+          "rank": log.rank, "ts": round(time.time(), 3)}
+    ev.update(t)
+    if extra:
+        ev.update(extra)
+    log.emit(ev)
+    log.boundary()
+
+
 @contextlib.contextmanager
 def phase(name: str):
     """Host phase timer (checkpoint save, data load, gang gather): records

@@ -133,6 +133,40 @@ def test_step_events_flush_as_jsonl_with_schema(tmp_path):
     assert m.timing("telemetry.step.kmeans")["count"] == 2
 
 
+def test_record_timing_surfaces_timing_schema_in_steps_jsonl(tmp_path):
+    """ISSUE 10 satellite: timing() percentile output rides steps.jsonl as
+    `kind: "timing"` events — the serving bench's latency rows and the
+    straggler report's per-rank rows share ONE latency format (the
+    Metrics.timing() dict), instead of two drifting schemas."""
+    m = Metrics()
+    telemetry.configure(str(tmp_path), interval=100, rank=1)
+    # no samples yet: record_timing is a no-op, never a malformed event
+    telemetry.record_timing("serve.latency.mixed", metrics=m)
+    for v in (0.001, 0.002, 0.003):
+        m.observe("serve.latency.mixed", v)
+    telemetry.record_timing("serve.latency.mixed", metrics=m,
+                            extra={"mix": "mixed", "qps": 123.0})
+    telemetry.active().flush()
+    events = _read_jsonl(tmp_path / "rank1" / "steps.jsonl")
+    assert len(events) == 1
+    ev = events[0]
+    assert ev["kind"] == "timing" and ev["rank"] == 1
+    assert ev["name"] == "serve.latency.mixed"
+    assert ev["mix"] == "mixed" and ev["qps"] == 123.0
+    # the event's latency fields are EXACTLY the timing() dict — the same
+    # keys gang.straggler_report reads from each rank's snapshot
+    timing = m.timing("serve.latency.mixed")
+    assert {k: ev[k] for k in timing} == timing
+    assert set(timing) <= set(ev)
+
+
+def test_record_timing_noop_when_disabled():
+    m = Metrics()
+    m.observe("serve.latency.mixed", 0.001)
+    telemetry.record_timing("serve.latency.mixed", metrics=m)
+    assert telemetry.active() is None
+
+
 def test_ring_is_bounded_and_drops_are_counted(tmp_path):
     m = Metrics()
     log = step_log.StepLog(str(tmp_path), capacity=8, rank=0, metrics=m)

@@ -170,6 +170,38 @@ CLAIMS: List[Claim] = [
           r"\| (\S+) B",
           ("targets", "sgd_mf_dense_fused", "fused_dma_bytes_per_step"),
           rel_tol=0.0, file="tools/collective_budget.json"),
+    # README "Online serving" + PERF.md r11 (ISSUE 10): the committed
+    # CPU-mesh serving latency/QPS rows (the bench group always measures —
+    # the router/batcher stack is host-side; the on-chip re-measure
+    # rewrites the record AND must update this prose, by design), plus the
+    # serve dispatch byte pins against the traced manifest (exact, tol 0 —
+    # the classify dispatch is pinned at ZERO collective bytes).
+    Claim("serving_mixed_p50", "README.md",
+          r"mixed traffic p50 (\S+) ms",
+          ("serving", "mixes", "mixed", "p50_ms")),
+    Claim("serving_mixed_p99", "README.md",
+          r"mixed traffic p50 \S+ ms\s*/ p99 (\S+) ms",
+          ("serving", "mixes", "mixed", "p99_ms")),
+    Claim("serving_mixed_qps", "README.md",
+          r"at (\S+) QPS",
+          ("serving", "mixes", "mixed", "qps")),
+    Claim("serving_perf_topk_heavy_p50", "PERF.md",
+          r"\| topk_heavy \(0\.8\) \| (\S+) ms",
+          ("serving", "mixes", "topk_heavy", "p50_ms")),
+    Claim("serving_perf_mixed_p50", "PERF.md",
+          r"\| mixed \(0\.5\) \| (\S+) ms",
+          ("serving", "mixes", "mixed", "p50_ms")),
+    Claim("serving_perf_mixed_qps", "PERF.md",
+          r"\| mixed \(0\.5\) \| \S+ ms \| \S+ ms \| (\S+) \|",
+          ("serving", "mixes", "mixed", "qps")),
+    Claim("comm_serve_classify", "PERF.md",
+          r"Serve classify dispatch \(serve_classify_nn\) \| (\S+) B",
+          ("targets", "serve_classify_nn", "bytes_per_step"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("comm_serve_topk", "PERF.md",
+          r"Serve top-k lookup \(serve_topk_mf\) \| (\S+) B",
+          ("targets", "serve_topk_mf", "bytes_per_step"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
 ]
 
 

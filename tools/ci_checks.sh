@@ -13,7 +13,16 @@
 #                     hops are booked as the `fused_dma` kind with explicit
 #                     fused_dma_bytes_per_step rows, so a fused schedule
 #                     silently reverting to bare ppermute moves bytes
-#                     between kinds and fails here too);
+#                     between kinds and fails here too.
+#                     r11: the manifest also pins the ONLINE-SERVING
+#                     dispatch programs (harp_tpu/serve/):
+#                     serve_classify_nn at ZERO collectives and
+#                     serve_topk_mf at exactly the keyval-lookup
+#                     all_to_all x3 + overflow psum — a collective
+#                     sneaking into the resident predict dispatch, or its
+#                     bytes growing, fails JL201/JL203; the one-compile-
+#                     per-(model,bucket) retrace contract is asserted by
+#                     tests/test_serve.py in stage 4);
 #                     nonzero on any finding or stale allowlist entry.
 #   2. telemetry    — the jaxpr engine re-run with the gang telemetry layer
 #                     ENABLED (HARP_TELEMETRY_DIR set): the instrumented

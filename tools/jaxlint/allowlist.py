@@ -88,6 +88,30 @@ ALLOWLIST: Allowlist = {
         "sweep harness: one failing width config must record its error "
         "string and let the remaining grid points run (bench must not "
         "die mid-sweep)",
+    ("harp_tpu/benchmark/serving_load.py", "_client_loop", "JL105"):
+        "closed-loop load thread: any per-request failure (ServeError, "
+        "timeout, transport reset) must be counted into the row's errors "
+        "field and the mix kept running — a dying generator would turn a "
+        "server-side error into a missing measurement",
+    ("harp_tpu/serve/batcher.py", "_dispatch", "JL105"):
+        "a malformed query payload in a coalesced serving batch can raise "
+        "anything from dtype casts to shape errors deep in the dispatch; "
+        "the micro-batcher must reply dispatch-error to the batch and keep "
+        "serving live traffic, never die mid-stream",
+    ("harp_tpu/serve/batcher.py", "_safe_reply", "JL105"):
+        "a reply-path failure (malformed reply_to past the router guard, "
+        "transport edge case) must cost exactly one reply, logged and "
+        "counted — never the batcher thread or the rest of a served "
+        "batch's replies",
+    ("harp_tpu/serve/router.py", "_loop", "JL105"):
+        "the worker's receive thread is its lifeline: a malformed request "
+        "frame (missing id, unhashable model) beyond the typed guards "
+        "must cost one dropped frame — logged and counted — never kill "
+        "the serving loop and blackhole all subsequent traffic",
+    ("harp_tpu/serve/router.py", "_close_at_exit", "JL105"):
+        "interpreter-exit cleanup over the live worker/client set: one "
+        "wedged close (drain timeout, dead socket) must not skip closing "
+        "the remaining objects — each gets its attempt, failures logged",
     ("harp_tpu/sched/dynamic.py", "_monitor", "JL105"):
         "BaseException on purpose: a failing task must still fill its "
         "output slot or consumers block forever in wait_for_output; the "

@@ -180,6 +180,32 @@ def _nn():
                 params0)
 
 
+def _serve_classify():
+    from harp_tpu.models import nn
+    from harp_tpu.serve import endpoints as serve_ep
+
+    sess = _session()
+    model = nn.MLPClassifier(sess, nn.NNConfig(layers=(8,), num_classes=3))
+    model.params = nn.init_params((12, 8, 3), seed=0)
+    ep = serve_ep.classify_from_nn(sess, model, name="nn")
+    x = _rng().normal(size=(ep.bucket_sizes[0], 12)).astype("float32")
+    fn, args, _n, _bucket = ep.prepared(x)
+    return fn, args
+
+
+def _serve_topk():
+    from harp_tpu.serve import endpoints as serve_ep
+
+    sess = _session()
+    rng = _rng()
+    uf = rng.normal(size=(64, 8)).astype("float32")
+    items = rng.normal(size=(32, 8)).astype("float32")
+    ep = serve_ep.TopKEndpoint(sess, "mf", uf, items, k=4)
+    ids = rng.integers(0, 64, size=ep.bucket_sizes[0])
+    fn, args, _n, _bucket = ep.prepared(ids)
+    return fn, args
+
+
 # Registry: target name -> builder returning (traceable callable, args).
 # Names are the manifest keys — renaming one is a manifest change.
 # The *_int8/*_bf16 rows pin the QUANTIZED step programs: their byte rows
@@ -193,6 +219,16 @@ def _nn():
 # between kinds and fails the gate. lda_cgs_quantwt_int8 pins the
 # satellite quantized wt-block rotation (ISSUE 9): its ppermute bytes sit
 # far below lda_cgs's because the (vpb, K) block ships int8+scales.
+# The serve_* rows (r11) pin the ONLINE-SERVING dispatch programs:
+# serve_classify_nn must stay at ZERO collectives (replicated params,
+# sharded query batch — a psum/all_gather sneaking into the resident
+# predict dispatch fails JL201 loudly), and serve_topk_mf must stay at
+# exactly the 3 all_to_alls of the keyval DistributedKV lookup
+# (bucket_route payload + mask, route_back) — the parameter-server pull
+# path the top-k endpoint serves from. Retrace policing is the other half:
+# the endpoints hold one compiled fn per (model, batch-bucket) in the
+# JL103-clean `self._fns[bucket]` cache, and tests/test_serve.py asserts
+# exactly one trace per bucket under live traffic.
 TARGETS: Dict[str, Callable[[], Tuple[Callable, tuple]]] = {
     "kmeans_regroupallgather": _kmeans("regroupallgather"),
     "kmeans_allreduce": _kmeans("allreduce"),
@@ -212,4 +248,6 @@ TARGETS: Dict[str, Callable[[], Tuple[Callable, tuple]]] = {
     "als_explicit": _als,
     "pagerank": _pagerank,
     "nn_mlp": _nn,
+    "serve_classify_nn": _serve_classify,
+    "serve_topk_mf": _serve_topk,
 }
