@@ -988,6 +988,48 @@ def tpu_serving(small=False):
     return row
 
 
+def tpu_reshard(small=False):
+    """On-device reshard rows (ISSUE 11): seconds + bytes moved for a
+    world-size-changing factor-table redistribution vs the PR 8 host
+    gather-and-resplit on the same maps (harp_tpu/benchmark/reshard_bench).
+    Two legs: ``cpu_mesh`` is MEASURED in a subprocess on the 8-worker
+    virtual CPU mesh (the engine is backend-agnostic — same plan, same
+    traced program shape as on chip), committed per the CPU-session
+    convention; ``gb_scale`` is the multi-chip on-chip row (a >=2-chip
+    mesh moving a GB-scale table over ICI) and stays null-with-note until
+    the driver's on-chip run."""
+    import jax
+
+    rows, rank = (65536, 32) if small else (262144, 64)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "") +
+                         " --xla_force_host_platform_device_count=8"
+                         ).strip()}
+    out = subprocess.run(
+        [sys.executable, "-m", "harp_tpu.benchmark.reshard_bench",
+         f"--rows={rows}", f"--rank={rank}"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=1200)
+    if out.returncode != 0:
+        return {"cpu_mesh": {"error": out.stderr[-500:]}, "gb_scale": None}
+    cpu_row = json.loads(out.stdout.strip().splitlines()[-1])
+    row = {"cpu_mesh": cpu_row}
+    tpu_devs = [d for d in jax.devices() if d.platform != "cpu"]
+    if len(tpu_devs) >= 2:
+        from harp_tpu.benchmark import reshard_bench
+
+        row["gb_scale"] = reshard_bench.measure(
+            num_workers=len(tpu_devs), rows=2_097_152, rank=128,
+            old_world=max(len(tpu_devs) // 2, 1))
+    else:
+        row["gb_scale"] = None
+        row["gb_scale_note"] = (
+            f"GB-scale on-chip reshard needs a >=2-chip mesh; this session "
+            f"sees {len(tpu_devs)} non-CPU device(s) — the driver's "
+            f"on-chip run fills it (rows=2097152 rank=128 f32 ~= 1 GB "
+            f"table, chunk-bounded ICI rounds)")
+    return row
+
+
 def p2p_event_rtt_us(rounds=200):
     """Host event-plane round trip (send → wait_event → reply → wait): the
     latency the true P2P transport (authenticated, loopback) delivers.
@@ -1066,7 +1108,7 @@ ROW_GROUPS = ("kmeans", "kmeans_padded128", "kmeans_csr", "sgd_mf", "als",
               "nn_compute_bound", "attention", "attention_blocksparse",
               "kernel_svm", "mds", "sort", "csr_cov", "kmeans_from_files",
               "p2p", "mesh", "collectives_quantized", "telemetry_overhead",
-              "ring_dma_overlap", "serving")
+              "ring_dma_overlap", "serving", "reshard")
 
 
 def main():
@@ -1481,6 +1523,21 @@ def main():
                 "serving_mixed_p99_ms": mixed.get("p99_ms"),
                 "serving_mixed_qps": mixed.get("qps"),
                 "serving_device": srow.get("device")})
+
+    if want("reshard"):
+        begin("reshard")
+        try:
+            rsrow = tpu_reshard(small)
+        except Exception as e:     # noqa: BLE001 — bench must not die here
+            rsrow = {"error": str(e)[:200]}
+        detail["reshard"] = rsrow
+        cpu_mesh = rsrow.get("cpu_mesh") if isinstance(rsrow, dict) else None
+        if isinstance(cpu_mesh, dict) and "reshard_seconds" in cpu_mesh:
+            compact.update({
+                "reshard_seconds": cpu_mesh["reshard_seconds"],
+                "reshard_bytes_moved": cpu_mesh["reshard_bytes_moved"],
+                "reshard_host_vs_device_speedup":
+                    cpu_mesh["host_vs_device_speedup"]})
 
     detail["xeon_anchor_note"] = (
         f"vs_cpu = measured vs ONE modern Zen core (this host has 1 "

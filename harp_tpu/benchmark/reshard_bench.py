@@ -1,0 +1,120 @@
+"""Reshard bench — device bounded-round redistribution vs the host gather.
+
+Times a world-size-changing factor-table redistribution (the PR 8 elastic
+resume scenario: a W_old checkpoint onto a W_new mesh) three ways on the
+same (bin, slot) maps:
+
+* ``reshard_seconds`` — the collectives/reshard.py all_to_all schedule
+  (chunk-bounded rounds ON the mesh; the r12 default resume path),
+* ``reshard_ring_seconds`` — the ppermute/ring schedule,
+* ``host_gather_seconds`` — the PR 8 numpy gather-and-resplit
+  (collectives.repartition) plus the device re-upload it implies,
+
+and reports ``reshard_bytes_moved`` (payload bytes that actually cross a
+worker boundary under the plan) next to ``host_gather_bytes`` (the full
+table every host-path worker materializes).  Device results are verified
+BITWISE against the host oracle before anything is timed.
+
+Standalone entry point prints one JSON row — ``bench.py --only reshard``
+runs it in a subprocess on the 8-worker virtual CPU mesh (the engine is
+backend-agnostic; the driver's on-chip run re-measures at the GB scale).
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+
+
+def measure(num_workers: int = 8, rows: int = 262144, rank: int = 64,
+            old_world: int = 4, chunk_bytes: int = 1 << 20,
+            reps: int = 3) -> dict:
+    import jax
+    import numpy as np
+
+    from harp_tpu.collectives import repartition as rep
+    from harp_tpu.collectives import reshard as rs
+    from harp_tpu.models.sgd_mf import identity_assign, serpentine_assign
+    from harp_tpu.session import HarpSession
+
+    sess = HarpSession(num_workers=num_workers)
+    rng = np.random.default_rng(0)
+    old_rpb = -(-rows // old_world)
+    new_rpb = -(-rows // num_workers)
+    old_assign = serpentine_assign(rng.integers(1, 64, rows), old_world)
+    new_assign = identity_assign(rows, num_workers)
+    saved = rng.standard_normal((old_world * old_rpb, rank)).astype(
+        np.float32)
+    fill_host = np.zeros((num_workers * new_rpb, rank), np.float32)
+    old_lay = rs.block_layout(old_assign, old_rpb, old_world)
+    new_lay = rs.block_layout(new_assign, new_rpb, num_workers)
+
+    # host oracle (timed below) doubles as the bitwise parity reference
+    oracle = rep.repartition_factor(saved, old_assign, old_rpb, new_assign,
+                                    new_rpb, rows, fill_host.copy())
+
+    def time_schedule(schedule):
+        plan = rs.plan_factor_reshard(old_lay, old_world, new_lay,
+                                      num_workers, rows, rank * 4,
+                                      chunk_bytes, schedule)
+        fill = sess.scatter(fill_host)
+        fn, args = rs.prepare_reshard(sess, saved, plan, fill)
+        out = fn(*args)
+        jax.block_until_ready(out)            # compile + warm
+        np.testing.assert_array_equal(np.asarray(out), oracle)
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            times.append(time.perf_counter() - t0)
+        return statistics.median(times), plan
+
+    a2a_s, a2a_plan = time_schedule("alltoall")
+    ring_s, ring_plan = time_schedule("ring")
+
+    host_times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        moved = rep.repartition_factor(saved, old_assign, old_rpb,
+                                       new_assign, new_rpb, rows,
+                                       fill_host.copy())
+        jax.block_until_ready(sess.scatter(moved))   # the re-upload it implies
+        host_times.append(time.perf_counter() - t0)
+    host_s = statistics.median(host_times)
+
+    table_bytes = saved.nbytes
+    return {
+        "config": (f"rows={rows} rank={rank} f32 W{old_world}->"
+                   f"W{num_workers} chunk={chunk_bytes}B serpentine->"
+                   f"identity maps"),
+        "rows": rows, "rank": rank,
+        "old_world": old_world, "new_world": num_workers,
+        "chunk_bytes": chunk_bytes,
+        "rounds": a2a_plan.rounds,
+        "ring_rounds": ring_plan.rounds,
+        "reshard_seconds": round(a2a_s, 4),
+        "reshard_ring_seconds": round(ring_s, 4),
+        "reshard_bytes_moved": a2a_plan.bytes_moved,
+        "reshard_mb_per_sec": round(a2a_plan.bytes_moved / a2a_s / 1e6, 1),
+        "host_gather_seconds": round(host_s, 4),
+        "host_gather_bytes": table_bytes,
+        "host_vs_device_speedup": round(host_s / a2a_s, 2),
+        "parity": "bitwise vs repartition_factor (checked this run)",
+        "device": jax.devices()[0].platform,
+        "workers": num_workers,
+    }
+
+
+def main(argv=None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    kw = {}
+    for a in argv:
+        k, _, v = a.lstrip("-").partition("=")
+        kw[k] = int(v)
+    print(json.dumps(measure(**kw)))
+
+
+if __name__ == "__main__":
+    main()

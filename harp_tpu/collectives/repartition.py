@@ -14,6 +14,14 @@ stay bitwise: restore never traces, never adds a collective, never changes a
 pinned step program — the resized gang's programs are simply the ones the new
 world size always had.
 
+**r12:** this module is no longer the default resume path — it is the PARITY
+ORACLE and 1-worker fallback for :mod:`collectives.reshard`, the device-side
+twin that moves the same rows between the same layouts ON the mesh in
+chunk-bounded collective rounds (bitwise-equal by contract,
+tests/test_reshard.py). Full-table host materialization is exactly what
+production factor-table sizes cannot afford; keep new call sites on the
+device engine unless they run where no mesh exists.
+
 Two leaf families, mirroring the table partitioners next door (table_ops):
 
 * **replicated** leaves (K-means centroids) re-partition EXACTLY — identity;

@@ -1,0 +1,438 @@
+"""On-device live resharding — collective array redistribution.
+
+The device-side counterpart of :mod:`collectives.repartition` (the host-numpy
+gather-and-resplit PR 8 introduced for world-size-agnostic resume).  The host
+path is correct but it is exactly the anti-pattern arXiv:2112.01075
+("Memory-efficient array redistribution through portable collective
+communication", PAPERS.md) exists to kill at production factor-table sizes:
+every sharded leaf is materialized IN FULL on every host, permuted with fancy
+indexing, and re-uploaded.  This module moves the rows between two block
+layouts ON the mesh instead: the (old bin/slot → new bin/slot) permutation is
+decomposed host-side into a bounded sequence of ``all_to_all`` / ``ppermute``
+ROUNDS whose per-round payload never exceeds a configured ``chunk_bytes`` —
+the paper's memory-efficient schedule: no worker ever materializes more than
+one round's worth of foreign rows, vs the host path's full table.
+
+Contract:
+
+* **bitwise** — rows are copied verbatim (gather → collective → scatter, no
+  arithmetic), so the device result is bit-identical to
+  ``repartition.repartition_factor`` / ``rematch_tokens`` on the same maps.
+  The numpy path stays as the parity oracle and the ``num_workers == 1``
+  small-world fallback.
+* **bounded** — every collective in the traced program carries at most
+  ``chunk_bytes`` of row payload (the all_to_all operand for the default
+  schedule, each ppermute for the ring schedule).  The jaxlint manifest pins
+  the reshard step program (``reshard_factor_a2a`` / ``reshard_factor_ring``
+  trace targets): a schedule that silently degrades to a full gather grows
+  its per-round bytes and fails JL203 exactly like a quantized path
+  reverting to f32.
+* **composable** — the ring schedule rides ``lax_ops.rotate``, so the
+  ``quant=`` wire codecs and the DCN link-class chunking
+  (``rotation.chunks_for_link``) compose for cross-pod hops.  A quantized
+  wire trades the bitwise contract for volume, exactly as it does for
+  training hops — leave ``comm=None`` (the default) when resuming.
+
+Index maps (``plan_moves``) are host-computed int32 arrays proportional to
+the number of ROWS moved — they are the permutation's description, not its
+payload (for a rank-64 f32 factor table they are ~1/32 of the leaf), and
+they are the same (bin, slot) assignments the checkpoint already carries.
+
+Layout vocabulary: a row-sharded leaf lives on the mesh in *device order* —
+worker ``w`` holds ``local_rows`` consecutive rows of the flattened global
+array.  A :class:`RowLayout` maps canonical ids into that order through the
+model's (bin, slot) assignment plus the bin→(worker, base) placement
+(1-slice: bin b on worker b at base 0; 2-slice: bin b on worker ``b % W`` at
+base ``(b // W) * rows_per_bin`` — the worker-major half-slice stacking of
+``sgd_mf._place_h0`` / LDA's 2-slice wt).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from harp_tpu.parallel.mesh import WORKERS
+
+# One round of foreign rows per worker: 1 MiB by default — small enough that
+# even a GB-scale table reshards in bounded memory, large enough that the
+# round count stays in the hundreds (a v5e ICI link moves 1 MiB in ~10 us).
+DEFAULT_CHUNK_BYTES = 1 << 20
+
+
+def resolve_mode(mode: str, num_workers: int) -> str:
+    """The ONE resume-reshard mode resolution every model shares
+    (``SGDMFConfig.reshard`` / ``LDAConfig.reshard``): validates
+    ``auto|device|ring|host`` and resolves ``auto`` to the device schedule
+    on a multi-worker mesh, to the host oracle on a 1-worker mesh (the
+    small-world fallback — nothing to redistribute over)."""
+    if mode not in ("auto", "device", "ring", "host"):
+        raise ValueError(f"reshard must be auto|device|ring|host, "
+                         f"got {mode!r}")
+    if mode == "auto":
+        return "host" if num_workers == 1 else "device"
+    return mode
+
+
+# --------------------------------------------------------------------------- #
+# Layouts
+# --------------------------------------------------------------------------- #
+
+@dataclasses.dataclass(frozen=True)
+class RowLayout:
+    """Where each canonical id's row lives on a ``num_workers`` mesh."""
+
+    bins: np.ndarray          # (n_ids,) bin of canonical id i
+    slots: np.ndarray         # (n_ids,) slot within the bin
+    rows_per_bin: int
+    num_bins: int
+    bin_owner: np.ndarray     # (num_bins,) worker holding each bin
+    bin_base: np.ndarray      # (num_bins,) local row offset of the bin
+    local_rows: int           # device rows per worker
+
+    @property
+    def total_rows(self) -> int:
+        return self.num_bins * self.rows_per_bin
+
+    def device_positions(self, n_valid: int) -> np.ndarray:
+        """Flat device-order position of each of the first ``n_valid`` ids:
+        ``owner * local_rows + base + slot``."""
+        b = np.asarray(self.bins[:n_valid], np.int64)
+        s = np.asarray(self.slots[:n_valid], np.int64)
+        if len(b) and (b.min() < 0 or b.max() >= self.num_bins
+                       or s.min() < 0 or s.max() >= self.rows_per_bin):
+            raise ValueError(
+                f"assignment maps address (bin, slot) outside the layout "
+                f"({self.num_bins} bins x {self.rows_per_bin} rows) — the "
+                f"maps do not describe this layout")
+        return (np.asarray(self.bin_owner, np.int64)[b] * self.local_rows
+                + np.asarray(self.bin_base, np.int64)[b] + s)
+
+
+def block_layout(assign: Tuple[np.ndarray, np.ndarray], rows_per_bin: int,
+                 num_workers: int, num_slices: int = 1) -> RowLayout:
+    """Layout of a (bin, slot)-assigned factor table.
+
+    ``num_slices=1``: bin b lives whole on worker b (the W factor, 1-slice H,
+    1-slice LDA wt).  ``num_slices=2``: bins are worker-major half-slices —
+    bin b on worker ``b % W`` at base ``(b // W) * rows_per_bin`` (the
+    ``_place_h0`` / 2-slice wt stacking)."""
+    num_bins = num_slices * num_workers
+    b = np.arange(num_bins)
+    return RowLayout(
+        bins=np.asarray(assign[0]), slots=np.asarray(assign[1]),
+        rows_per_bin=int(rows_per_bin), num_bins=num_bins,
+        bin_owner=(b % num_workers).astype(np.int64),
+        bin_base=((b // num_workers) * rows_per_bin).astype(np.int64),
+        local_rows=num_slices * int(rows_per_bin))
+
+
+def contiguous_split(positions: np.ndarray, total_rows: int,
+                     num_workers: int) -> Tuple[np.ndarray, np.ndarray, int]:
+    """(worker, slot, padded_total) of flat positions under an even
+    contiguous split over ``num_workers`` — how a flat host leaf (or a live
+    device array) shards over the mesh."""
+    per = -(-max(int(total_rows), 1) // num_workers)
+    p = np.asarray(positions, np.int64)
+    if len(p) and (p.min() < 0 or p.max() >= total_rows):
+        raise ValueError(
+            f"positions address rows outside the flat leaf "
+            f"({total_rows} rows, max {p.max() if len(p) else 0})")
+    return p // per, p % per, per * num_workers
+
+
+# --------------------------------------------------------------------------- #
+# Plans
+# --------------------------------------------------------------------------- #
+
+@dataclasses.dataclass(frozen=True)
+class ReshardPlan:
+    """Host-computed move schedule: which local row each worker ships to
+    each peer in each bounded round, and where received rows land."""
+
+    num_workers: int
+    schedule: str             # "alltoall" | "ring"
+    chunk_rows: int           # rows per (peer, round) — the byte bound
+    src_rows: int             # padded flat source rows (divides num_workers)
+    dst_rows: int             # flat destination rows (divides num_workers)
+    rounds: int               # alltoall rounds (ring: sum over shifts)
+    # alltoall: (W, rounds, W, C) send local-slots / recv local-positions,
+    # -1 = pad.  ring: per shift s in 0..W-1, (W, rounds_s, C) pairs; shift 0
+    # is the local (no-wire) copy.
+    send_idx: Optional[np.ndarray]
+    recv_pos: Optional[np.ndarray]
+    ring_rounds: Optional[Tuple[Tuple[np.ndarray, np.ndarray], ...]]
+    moved_rows: int           # rows that cross a worker boundary
+    local_rows_moved: int     # rows that stay on their worker
+    row_bytes: int
+
+    @property
+    def bytes_moved(self) -> int:
+        """Payload bytes that cross a worker boundary (the wire volume the
+        bench rows report; the host path gathers ``src_rows * row_bytes`` to
+        EVERY worker instead)."""
+        return self.moved_rows * self.row_bytes
+
+
+def plan_moves(src_pos: np.ndarray, dst_pos: np.ndarray, src_rows: int,
+               dst_rows: int, num_workers: int, row_bytes: int,
+               chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+               schedule: str = "alltoall") -> ReshardPlan:
+    """Decompose a flat-position permutation into bounded collective rounds.
+
+    ``src_pos[i]`` / ``dst_pos[i]`` are the flat device-order positions of
+    moved row i in the source and destination leaves.  The source is (or is
+    placed as) an even contiguous split of ``src_rows`` over the mesh; the
+    destination layout's ``dst_rows`` must already divide the mesh."""
+    if schedule not in ("alltoall", "ring"):
+        raise ValueError(f"schedule must be alltoall|ring, got {schedule!r}")
+    w = int(num_workers)
+    src_pos = np.asarray(src_pos, np.int64)
+    dst_pos = np.asarray(dst_pos, np.int64)
+    if len(src_pos) != len(dst_pos):
+        raise ValueError(f"{len(src_pos)} source positions vs "
+                         f"{len(dst_pos)} destinations")
+    sw, ss, src_pad = contiguous_split(src_pos, src_rows, w)
+    if dst_rows % w:
+        raise ValueError(f"destination rows {dst_rows} must divide the "
+                         f"{w}-worker mesh")
+    dst_local = dst_rows // w
+    if len(dst_pos) and (dst_pos.min() < 0 or dst_pos.max() >= dst_rows):
+        raise ValueError(
+            f"destination positions address rows outside the new layout "
+            f"({dst_rows} rows, max {dst_pos.max()})")
+    dw, ds = dst_pos // dst_local, dst_pos % dst_local
+    if len(dst_pos) != len(np.unique(dst_pos)):
+        raise ValueError("destination positions collide — the new layout "
+                         "maps two ids onto one row")
+    row_bytes = max(int(row_bytes), 1)
+    n = len(src_pos)
+    cross = sw != dw
+    if schedule == "alltoall":
+        # foreign footprint per round = the all_to_all operand: W chunks of
+        # C rows -> C = chunk_bytes / (W * row_bytes)
+        chunk = max(1, int(chunk_bytes) // (w * row_bytes))
+        pair = sw * w + dw
+        order = np.argsort(pair, kind="stable")
+        counts = np.bincount(pair, minlength=w * w)
+        rounds = max(1, -(-int(counts.max(initial=0)) // chunk))
+        starts = np.concatenate([[0], np.cumsum(counts)])
+        rank = np.arange(n) - starts[pair[order]]
+        r, c = np.divmod(rank, chunk)
+        send = np.full((w, rounds, w, chunk), -1, np.int32)
+        recv = np.full((w, rounds, w, chunk), -1, np.int32)
+        send[sw[order], r, dw[order], c] = ss[order].astype(np.int32)
+        recv[dw[order], r, sw[order], c] = ds[order].astype(np.int32)
+        return ReshardPlan(w, schedule, chunk, src_pad, dst_rows, rounds,
+                           send, recv, None, int(cross.sum()),
+                           int(n - cross.sum()), row_bytes)
+    # ring: one ppermute per shift, chunked into rounds of C rows each so a
+    # single hop never carries more than chunk_bytes
+    chunk = max(1, int(chunk_bytes) // row_bytes)
+    shift = (dw - sw) % w
+    per_shift = []
+    total_rounds = 0
+    for s in range(w):
+        m = shift == s
+        ssw, sss, sds = sw[m], ss[m], ds[m]
+        counts = np.bincount(ssw, minlength=w)
+        rounds_s = max(1, -(-int(counts.max(initial=0)) // chunk)) \
+            if m.any() else 0
+        if rounds_s == 0:
+            per_shift.append((np.full((w, 0, chunk), -1, np.int32),
+                              np.full((w, 0, chunk), -1, np.int32)))
+            continue
+        order = np.argsort(ssw, kind="stable")
+        starts = np.concatenate([[0], np.cumsum(counts)])
+        rank = np.arange(m.sum()) - starts[ssw[order]]
+        r, c = np.divmod(rank, chunk)
+        send = np.full((w, rounds_s, chunk), -1, np.int32)
+        recv = np.full((w, rounds_s, chunk), -1, np.int32)
+        send[ssw[order], r, c] = sss[order].astype(np.int32)
+        # the receiver of shift s from sender ssw is (ssw + s) % w; entry c
+        # of the sender's chunk lands at entry c on the receiver
+        recv[(ssw[order] + s) % w, r, c] = sds[order].astype(np.int32)
+        per_shift.append((send, recv))
+        total_rounds += rounds_s
+    return ReshardPlan(w, schedule, chunk, src_pad, dst_rows, total_rounds,
+                       None, None, tuple(per_shift), int(cross.sum()),
+                       int(n - cross.sum()), row_bytes)
+
+
+def plan_factor_reshard(old: RowLayout, old_world: int, new: RowLayout,
+                        num_workers: int, n_valid: int, row_bytes: int,
+                        chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+                        schedule: str = "alltoall") -> ReshardPlan:
+    """Plan moving a (bin, slot)-sharded factor table saved by an
+    ``old_world`` gang onto this ``num_workers`` mesh's ``new`` layout.
+    The saved flat leaf (old device order) is placed as a contiguous split;
+    every id the data references moves to its new (bin, slot) row."""
+    src_pos = old.device_positions(n_valid)
+    dst_pos = new.device_positions(n_valid)
+    return plan_moves(src_pos, dst_pos, old_world * old.local_rows,
+                      num_workers * new.local_rows, num_workers, row_bytes,
+                      chunk_bytes, schedule)
+
+
+# --------------------------------------------------------------------------- #
+# Device programs
+# --------------------------------------------------------------------------- #
+
+def _row_meta(shape: Sequence[int], local_rows: int) -> Tuple[int, ...]:
+    """Per-row trailing shape of a flat leaf whose local block holds
+    ``local_rows`` rows (validates divisibility of the local element count)."""
+    elems = 1
+    for s in shape:
+        elems *= int(s)
+    if local_rows <= 0 or elems % local_rows:
+        raise ValueError(f"local block of {elems} elements does not hold "
+                         f"{local_rows} rows")
+    return (elems // local_rows,)
+
+
+def prepare_reshard(session, src, plan: ReshardPlan, fill, *, comm=None,
+                    link_class: Optional[str] = None):
+    """Build the reshard step program and its placed arguments.
+
+    ``src``: the saved leaf — a host ndarray in the OLD world's flat device
+    order (padded + scattered contiguously here), or a LIVE device array
+    already sharded over this mesh (rebalance / shard restore: zero host
+    involvement).  ``fill``: the device array supplying every row the plan
+    does not write (fresh init for padded slots, or the live table when only
+    some rows move).  Returns ``(fn, args)``; ``fn(*args)`` yields the
+    resharded leaf in ``fill``'s shape and sharding.  The device path NEVER
+    gathers a sharded leaf to host — no ``np.asarray`` of a device array
+    happens here or in the traced program.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from harp_tpu.collectives import lax_ops, rotation
+
+    w = plan.num_workers
+    if session.num_workers != w:
+        raise ValueError(f"plan was made for {w} workers; session has "
+                         f"{session.num_workers}")
+    # per-worker local blocks reshape to (local_rows, row_elems): row
+    # boundaries must survive the flatten, which they do for every layout
+    # this module defines (rows are the trailing-contiguous unit)
+    fill_shape = tuple(np.shape(fill))
+    src_shape = tuple(np.shape(src))
+    row_elems = _row_meta(fill_shape, plan.dst_rows)[0]
+    src_row_elems = _row_meta(src_shape, plan.src_rows)[0] \
+        if isinstance(src, jax.Array) else None
+    if isinstance(src, jax.Array):
+        if src_row_elems != row_elems:
+            raise ValueError(
+                f"source rows ({src_row_elems} elems) and destination rows "
+                f"({row_elems} elems) disagree")
+        src_dev = src
+    else:
+        # host leaf from the checkpoint: pad the flat device-order payload
+        # to the contiguous split and scatter — the one H2D the resume pays
+        # anyway; no device array is gathered back
+        flat = np.asarray(src).reshape(-1, row_elems)
+        if len(flat) > plan.src_rows:
+            raise ValueError(f"saved leaf has {len(flat)} rows; plan "
+                             f"expects at most {plan.src_rows}")
+        if len(flat) < plan.src_rows:
+            pad = np.zeros((plan.src_rows - len(flat), row_elems),
+                           flat.dtype)
+            flat = np.concatenate([flat, pad], axis=0)
+        src_dev = session.scatter(flat)
+    dst_local = plan.dst_rows // w
+    src_local = plan.src_rows // w
+    link = link_class
+
+    def _local_rows_of(x, rows):
+        return x.reshape((rows, row_elems))
+
+    if plan.schedule == "alltoall":
+        send = session.scatter(plan.send_idx)
+        recv = session.scatter(plan.recv_pos)
+
+        def prog(src_a, fill_a, send_a, recv_a):
+            src_l = _local_rows_of(src_a, src_local)
+            dst = _local_rows_of(fill_a, dst_local)
+            trash = dst_local            # pads land on a discarded row
+            dst = jnp.concatenate(
+                [dst, jnp.zeros((1, row_elems), dst.dtype)], axis=0)
+
+            def body(d, xs):
+                si, rp = xs              # (W, C) each
+                payload = src_l[jnp.maximum(si, 0).reshape(-1)]
+                moved = lax_ops.all_to_all(payload)
+                pos = jnp.where(rp.reshape(-1) >= 0, rp.reshape(-1), trash)
+                return d.at[pos].set(moved), None
+
+            dst, _ = jax.lax.scan(body, dst, (send_a[0], recv_a[0]))
+            return dst[:dst_local].reshape(fill_a.shape)
+
+        fn = session.spmd(prog, in_specs=(session.shard(),) * 4,
+                          out_specs=session.shard())
+        return fn, (src_dev, fill, send, recv)
+
+    placed = [(session.scatter(s), session.scatter(r))
+              for s, r in plan.ring_rounds]
+
+    def prog(src_a, fill_a, *rounds_args):
+        src_l = _local_rows_of(src_a, src_local)
+        dst = _local_rows_of(fill_a, dst_local)
+        trash = dst_local
+        dst = jnp.concatenate(
+            [dst, jnp.zeros((1, row_elems), dst.dtype)], axis=0)
+        for s in range(w):
+            send_a, recv_a = rounds_args[2 * s], rounds_args[2 * s + 1]
+            if send_a.shape[1] == 0:
+                continue
+
+            def body(d, xs, s=s):
+                si, rp = xs              # (C,) each
+                payload = src_l[jnp.maximum(si, 0)]
+                if s:
+                    nb = payload.size * payload.dtype.itemsize
+                    payload = lax_ops.rotate(
+                        payload, s, comm=comm,
+                        num_chunks=rotation.chunks_for_link(
+                            nb, rotation._resolve_link(link, WORKERS)))
+                pos = jnp.where(rp >= 0, rp, trash)
+                return d.at[pos].set(payload), None
+
+            dst, _ = jax.lax.scan(body, dst, (send_a[0], recv_a[0]))
+        return dst[:dst_local].reshape(fill_a.shape)
+
+    fn = session.spmd(prog,
+                      in_specs=(session.shard(),) * (2 + 2 * len(placed)),
+                      out_specs=session.shard())
+    args = (src_dev, fill) + tuple(a for pair in placed for a in pair)
+    return fn, args
+
+
+def reshard(session, src, plan: ReshardPlan, fill, *, comm=None,
+            link_class: Optional[str] = None):
+    """Run the bounded-round device reshard; returns the new leaf (device
+    array shaped and sharded like ``fill``).  One-shot per resume — the
+    compile is the price of NOT gathering the table (see prepare_reshard
+    for the no-host-gather contract)."""
+    fn, args = prepare_reshard(session, src, plan, fill, comm=comm,
+                               link_class=link_class)
+    return fn(*args)
+
+
+def reshard_factor(session, saved, old: RowLayout, old_world: int,
+                   new: RowLayout, n_valid: int, fill, *,
+                   chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+                   schedule: str = "alltoall", comm=None,
+                   link_class: Optional[str] = None):
+    """Device twin of :func:`collectives.repartition.repartition_factor`:
+    moves a (bin, slot)-sharded factor table from the layout it was SAVED
+    under onto this session's layout, bitwise, in chunk-bounded rounds."""
+    row_elems = _row_meta(np.shape(fill),
+                          session.num_workers * new.local_rows)[0]
+    row_bytes = row_elems * np.dtype(fill.dtype).itemsize
+    plan = plan_factor_reshard(old, old_world, new, session.num_workers,
+                               n_valid, row_bytes, chunk_bytes, schedule)
+    return reshard(session, saved, plan, fill, comm=comm,
+                   link_class=link_class)

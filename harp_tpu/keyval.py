@@ -159,17 +159,23 @@ class DistributedKV:
         self.axis_name = axis_name
 
     def update(self, keys, vals, combiner=combiner_lib.SUM, route_cap: int = 0,
-               mask=None):
+               mask=None, dest=None):
         """Route records to their owners and combine into the local stores.
         Returns (new DistributedKV, route_overflow, store_overflow). Masked
-        (padding) records are excluded without consuming route capacity."""
+        (padding) records are excluded without consuming route capacity.
+        ``dest`` (optional, (n,) int32 in [0, W)) overrides the ``key mod
+        W`` owner per record — the seam live REBALANCING uses: a store
+        whose shards were moved off a straggler routes by its explicit
+        owner map instead of the modulo (serve.endpoints.TopKEndpoint
+        .rebalance). Same collectives either way."""
         w = compat.axis_size(self.axis_name)
         n = keys.shape[0]
         cap = route_cap or default_route_capacity(n, w)
         k = keys.astype(jnp.int32)
         valid_in = (k != EMPTY) if mask is None else (mask & (k != EMPTY))
         (rk, rv), rm, ovf, _ = bucket_route(
-            k % w, cap, (jnp.where(valid_in, k, EMPTY), vals),
+            k % w if dest is None else dest, cap,
+            (jnp.where(valid_in, k, EMPTY), vals),
             valid=valid_in, axis_name=self.axis_name)
         flat_k = rk.reshape(-1)
         flat_v = rv.reshape((-1,) + rv.shape[2:])
@@ -179,18 +185,23 @@ class DistributedKV:
         return DistributedKV(store, self.axis_name), ovf, \
             jax.lax.psum(s_ovf, self.axis_name)
 
-    def lookup(self, keys, default=0, route_cap: int = 0, mask=None):
+    def lookup(self, keys, default=0, route_cap: int = 0, mask=None,
+               dest=None):
         """Distributed get: route queries to owners, answer, route back (one
         all_to_all each way; the found flag rides with the values). Returns
         (values, found) in the original query order; capacity-dropped or
         padding queries (``mask=False`` or the sentinel key) come back as
-        (default, False) without consuming route capacity."""
+        (default, False) without consuming route capacity. ``dest``: see
+        :meth:`update` — explicit per-query owners for rebalanced stores
+        (identical collective counts/kinds, so the serve dispatch budget
+        pins hold for both routings)."""
         w = compat.axis_size(self.axis_name)
         n = keys.shape[0]
         cap = route_cap or default_route_capacity(n, w)
         k = keys.astype(jnp.int32)
         valid_q = (k != EMPTY) if mask is None else (mask & (k != EMPTY))
-        (rk,), rm, _, routing = bucket_route(k % w, cap, (k,), valid=valid_q,
+        (rk,), rm, _, routing = bucket_route(k % w if dest is None else dest,
+                                             cap, (k,), valid=valid_q,
                                              axis_name=self.axis_name)
         q = jnp.where(rm > 0, rk, EMPTY).reshape(-1)
         vals, found = kv_lookup(self.store, q, default)

@@ -357,9 +357,14 @@ class KMeans:
 
         World-size-agnostic: the centroid table is REPLICATED, so a
         checkpoint written by a W-worker gang restores EXACTLY into a
-        W' != W gang (the supervisor's shrink-relaunch path) — only the
-        point shards re-split, which prepare() does per run. The manifest
-        meta records the writing world for the journal/debugging."""
+        W' != W gang (the supervisor's shrink-relaunch path) — the
+        resume-across-resize reshard (collectives.reshard) is the IDENTITY
+        for replicated leaves (every worker already holds the full table;
+        the new world replicates it at placement), so K-means pays zero
+        redistribution rounds where SGD-MF/LDA pay their bounded
+        all_to_all schedule. Only the point shards re-split, which
+        prepare() does per run. The manifest meta records the writing
+        world for the journal/debugging."""
         from harp_tpu.parallel import faults
         from harp_tpu.utils import checkpoint as ckpt_lib
 

@@ -159,6 +159,15 @@ class LDAConfig:
     #   the block moves HBM → remote HBM in-kernel with no staging copies;
     #   bitwise-identical schedule on every backend. A quantized wt wire
     #   (quant_wt) takes precedence over fusion (rotation.py module doc).
+    reshard: str = "auto"       # r12: HOW a world-size-changing resume moves
+    #   the chain state (token assignments z + word-topic counts wt) onto
+    #   this session's blocking: "device" = collectives/reshard.py bounded
+    #   all_to_all rounds on the mesh (z rows ride the token-key
+    #   permutation, wt rows ride the (word_block, word_slot) maps — no
+    #   host gather of a sharded leaf), "ring" = the ppermute schedule,
+    #   "host" = the PR 8 numpy re-match/rebuild (parity oracle + 1-worker
+    #   fallback), "auto" = device when the mesh has >1 worker.
+    reshard_chunk_bytes: int = 0  # 0 = collectives.reshard default (1 MiB)
 
 
 def bucketize_tokens(docs: np.ndarray, num_blocks: int, vpb: int,
@@ -824,8 +833,12 @@ class LDA:
                 saved = self._repartition_chain(saved, ck_meta,
                                                 layout_leaves, vpb,
                                                 tuple(z_cur.shape))
-            z_cur = sess.scatter(jnp.asarray(saved["z"]))
-            wt_cur = sess.scatter(jnp.asarray(saved["wt"]))
+            # the device reshard path hands back already-placed arrays in
+            # this session's sharding — no host round trip to undo
+            z_cur = (saved["z"] if isinstance(saved["z"], jax.Array)
+                     else sess.scatter(jnp.asarray(saved["z"])))
+            wt_cur = (saved["wt"] if isinstance(saved["wt"], jax.Array)
+                      else sess.scatter(jnp.asarray(saved["wt"])))
         chunk_fns = {}
         lls = []
         doc_topic = None
@@ -883,41 +896,52 @@ class LDA:
         return dt, wt_final, np.asarray(lls, np.float32), start
 
 
+    def _reshard_mode(self) -> str:
+        from harp_tpu.collectives import reshard as rs
+
+        return rs.resolve_mode(self.config.reshard,
+                               self.session.num_workers)
+
     def _repartition_chain(self, saved: dict, ck_meta, new_layout: dict,
                            vpb: int, new_z_shape: tuple) -> dict:
         """Chain state written at another world size → this session's
         blocked layout. Every token's topic assignment is re-matched onto
-        the new blocking by its (doc, vocab-id) key
-        (collectives.repartition.rematch_tokens) and the word-topic counts
-        are rebuilt from the matched assignments exactly as prepare() built
-        them from the init — so (doc-topic, word-topic, topic-total) counts
-        transfer EXACTLY, the only freedom being the exchangeable order of
-        same-word-same-doc occurrences. Host-side numpy, once per resume:
-        no collective is traced or added to any step program (jaxlint
-        JL201/JL203 budgets stay bitwise)."""
+        the new blocking by its (doc, vocab-id) key; word-topic counts
+        follow their (word_block, word_slot) maps. Default
+        (``LDAConfig.reshard``): both leaves move ON DEVICE through
+        collectives/reshard.py — the token match is computed host-side on
+        the INDEX arrays only (doc/vocab ids, not the payload), then z rows
+        and wt rows ride chunk-bounded all_to_all rounds on the mesh;
+        ``reshard="host"`` keeps the PR 8 numpy path (rematch_tokens + a
+        count rebuild) as the parity oracle. (doc-topic, word-topic,
+        topic-total) counts transfer EXACTLY either way, the only freedom
+        being the exchangeable order of same-word-same-doc occurrences;
+        2-slice blockings re-shard through the same worker-major half-slice
+        placement the factors use. Once per resume — no collective enters
+        any TRAINING step program (jaxlint JL201/JL203 budgets stay
+        bitwise; the reshard program has its own pinned targets)."""
         from harp_tpu.collectives import repartition as rep
+        from harp_tpu.collectives import reshard as rs
 
         cfg = self.config
+        sess = self.session
         if ck_meta is None or "world" not in ck_meta:
             raise ValueError(
                 "checkpoint does not match this session's chain shapes and "
                 "carries no world metadata (written by a pre-elastic "
                 "version?) — resume at the original worker count")
-        if int(ck_meta.get("num_model_slices", 1)) != 1 \
-                or cfg.num_model_slices != 1:
-            raise ValueError(
-                "world-size-agnostic resume supports num_model_slices=1 "
-                "only (the 2-slice wt layout interleaves worker-major "
-                f"half-slices); checkpoint has "
-                f"{ck_meta.get('num_model_slices')}, this config "
-                f"{cfg.num_model_slices}")
         if int(ck_meta.get("vocab", cfg.vocab)) != cfg.vocab \
                 or str(ck_meta.get("method", cfg.method)) != cfg.method:
             raise ValueError(
                 f"checkpoint chain (vocab={ck_meta.get('vocab')}, "
                 f"method={ck_meta.get('method')}) does not describe this "
                 f"model (vocab={cfg.vocab}, method={cfg.method})")
-        nb_old = int(ck_meta["world"])
+        old_world = int(ck_meta["world"])
+        old_ns = int(ck_meta.get("num_model_slices", 1))
+        new_ns = cfg.num_model_slices
+        w = sess.num_workers
+        saved_z = np.asarray(saved["z"])
+        nb_old = saved_z.shape[1]
         vpb_old = int(ck_meta["vpb"])
         nb_new = int(new_z_shape[1])
 
@@ -940,16 +964,61 @@ class LDA:
             raise ValueError(
                 "blocked corpus references slots outside its vocab id maps "
                 "— the checkpoint layout leaves are inconsistent")
+        k = cfg.num_topics
+        mode = self._reshard_mode()
+        if mode in ("device", "ring"):
+            schedule = "alltoall" if mode == "device" else "ring"
+            chunk = cfg.reshard_chunk_bytes or rs.DEFAULT_CHUNK_BYTES
+            # token match on the INDEX arrays (the rematch_tokens lexsort,
+            # payload-free): the k-th (doc, vocab) occurrence on the old
+            # side pairs with the k-th on the new side
+            old_order = np.lexsort((v_old, od))
+            new_order = np.lexsort((v_new, nd))
+            if not (np.array_equal(od[old_order], nd[new_order])
+                    and np.array_equal(v_old[old_order], v_new[new_order])):
+                raise ValueError(
+                    "checkpoint token multiset does not match the prepared "
+                    "corpus — the resumed run was prepared on different "
+                    "data than the checkpoint was written from")
+            lb_old, lb_new = saved_z.shape[2], int(new_z_shape[2])
+            src_pos = ((od * nb_old + ob) * lb_old + op)[old_order]
+            dst_pos = ((nd * nb_new + nb_i) * lb_new + np_i)[new_order]
+            row_elems = k if cfg.method == "cvb0" else 1
+            plan = rs.plan_moves(
+                src_pos, dst_pos, saved_z.shape[0] * nb_old * lb_old,
+                int(new_z_shape[0]) * nb_new * lb_new, w,
+                row_elems * saved_z.dtype.itemsize, chunk, schedule)
+            z_new = rs.reshard(
+                sess, saved_z, plan,
+                sess.scatter(np.zeros(new_z_shape, saved_z.dtype)))
+            # wt rows follow their word: moving row v verbatim IS the
+            # rebuild (counts per (word, topic) are blocking-invariant)
+            old_wt_lay = rs.block_layout(
+                (np.asarray(saved["word_block"]),
+                 np.asarray(saved["word_slot"])), vpb_old, old_world,
+                old_ns)
+            new_wt_lay = rs.block_layout(
+                (np.asarray(new_layout["word_block"]),
+                 np.asarray(new_layout["word_slot"])), vpb, w, new_ns)
+            wt_new = rs.reshard_factor(
+                sess, np.asarray(saved["wt"]), old_wt_lay, old_world,
+                new_wt_lay, cfg.vocab,
+                sess.scatter(np.zeros((nb_new * vpb, k), np.float32)),
+                chunk_bytes=chunk, schedule=schedule)
+            return {**saved, "z": z_new, "wt": wt_new}
         matched = rep.rematch_tokens(
-            od, v_old, np.asarray(saved["z"])[od, ob, op], nd, v_new)
-        z_new = np.zeros(new_z_shape, np.asarray(saved["z"]).dtype)
+            od, v_old, saved_z[od, ob, op], nd, v_new)
+        z_new = np.zeros(new_z_shape, saved_z.dtype)
         z_new[nd, nb_i, np_i] = matched
         # rebuild word-topic counts at the new blocking (prepare's formula)
-        k = cfg.num_topics
         contrib = (matched if cfg.method == "cvb0"
                    else np.eye(k, dtype=np.float32)[matched])
         wt = np.zeros((nb_new, vpb, k), np.float32)
         np.add.at(wt, (nb_i, slots_new), contrib)
+        if new_ns == 2:
+            # device order stacks worker-major half-slices (prepare's
+            # 2-slice placement) — mirror it so the scatter lands right
+            wt = wt.reshape(2, nb_new // 2, vpb, k).transpose(1, 0, 2, 3)
         return {**saved, "z": z_new, "wt": wt.reshape(nb_new * vpb, k)}
 
 

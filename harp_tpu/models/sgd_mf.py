@@ -99,6 +99,16 @@ class SGDMFConfig:
     #                              per-codec RMSE tolerance).
     dense_max_bytes: int = 6_000_000_000  # per-worker slab budget for auto-dense
     balance: bool = True       # serpentine-LPT id balancing for the sparse layout
+    reshard: str = "auto"      # r12: HOW a world-size-changing resume moves
+    #   the factor tables onto this session's layout (arXiv:2112.01075):
+    #   "device" = collective redistribution on the mesh (collectives/
+    #   reshard.py alltoall schedule — bitwise, chunk-bounded rounds, no
+    #   host gather of a sharded leaf), "ring" = the ppermute schedule
+    #   (rides lax_ops.rotate, so DCN link-class chunking composes),
+    #   "host" = the PR 8 numpy gather-and-resplit (kept as the parity
+    #   oracle and small-world fallback), "auto" = device when the mesh has
+    #   >1 worker, host on a 1-worker mesh (nothing to redistribute).
+    reshard_chunk_bytes: int = 0   # 0 = collectives.reshard default (1 MiB)
     fused_dma: bool = False    # r10: H-block rotation hops ride the fused
     #   ring-DMA engine (ops/ring_dma) instead of ppermute. On TPU with the
     #   fused dense hop kernel live, the hop fuses INTO the kernel
@@ -819,11 +829,13 @@ class SGDMF:
         world's permuted block layout PLUS the (bin, slot) id maps and a
         manifest meta naming the writing world. Resuming under a different
         worker count (the supervisor's shrink/re-place relaunch) restores
-        with the SAVED shapes and gather-and-resplits both factor tables
-        onto this session's layout (collectives.repartition) — exact for
-        every id the ratings reference, host-side, no collectives added to
-        any step program. Same-world resume takes the historical bitwise
-        path untouched.
+        with the SAVED shapes and re-shards both factor tables onto this
+        session's layout ON DEVICE (collectives.reshard: chunk-bounded
+        all_to_all rounds, bitwise the numpy oracle, no host gather of a
+        sharded leaf; ``SGDMFConfig.reshard`` selects the ring/host
+        alternatives) — exact for every id the ratings reference,
+        including across a 1-slice/2-slice layout change. Same-world
+        resume takes the historical bitwise path untouched.
         """
         from harp_tpu.parallel import faults
         from harp_tpu.utils import checkpoint as ckpt_lib
@@ -885,8 +897,14 @@ class SGDMF:
                     and "world" in ck_meta
                     else np.shape(saved["w"]) != tuple(w0.shape)):
                 saved = self._repartition_saved(saved, ck_meta, state)
-            w_cur = jax.device_put(np.asarray(saved["w"]), w0.sharding)
-            h_cur = jax.device_put(np.asarray(saved["h"]), h0.sharding)
+            # the device reshard path hands back already-placed arrays in
+            # this session's sharding — no host round trip to undo
+            w_cur = (saved["w"] if isinstance(saved["w"], jax.Array)
+                     else jax.device_put(np.asarray(saved["w"]),
+                                         w0.sharding))
+            h_cur = (saved["h"] if isinstance(saved["h"], jax.Array)
+                     else jax.device_put(np.asarray(saved["h"]),
+                                         h0.sharding))
         key = self._program(layout, nmb, 1, geom)
         fn = self._compiled[key]
         rmses = []
@@ -921,18 +939,34 @@ class SGDMF:
         w_final, h_final = self._finalize(w_cur, h_cur, meta)
         return w_final, h_final, np.asarray(rmses), start
 
+    def _reshard_mode(self) -> str:
+        from harp_tpu.collectives import reshard as rs
+
+        return rs.resolve_mode(self.config.reshard,
+                               self.session.num_workers)
+
     def _repartition_saved(self, saved: dict, ck_meta: Optional[dict],
                            state) -> dict:
         """Factor state written at another world size → this session's
-        layout (collectives.repartition): de-permute W/H to canonical id
-        order with the SAVED (bin, slot) maps, re-permute with this
-        prepare()'s maps. Exact for every id the ratings reference; padded
+        layout. Default (``SGDMFConfig.reshard``): the DEVICE collective
+        redistribution of collectives/reshard.py — the saved leaves go
+        host→device once (the H2D any resume pays) and every row moves to
+        its new (bin, slot) home in chunk-bounded all_to_all (or ring
+        ppermute) rounds ON the mesh; no sharded leaf is ever gathered to
+        host, and the returned leaves are device arrays already in this
+        session's sharding. ``reshard="host"`` keeps the PR 8 numpy
+        gather-and-resplit (collectives.repartition) as the parity oracle.
+        Both paths are exact for every id the ratings reference; padded
         slots keep this run's fresh init (training math never reads them —
         their counts are zero, so neither gradients nor the regularizer
-        move them). Host-side numpy, run once at resume: no collective is
-        traced or added to any step program, so the jaxlint per-step
-        budgets (JL201/JL203) stay bitwise."""
+        move them). 2-slice layouts re-shard like 1-slice through the
+        worker-major half-slice placement (reshard.block_layout), on
+        either side of the resize. Run once at resume: the reshard step
+        program is its own jaxlint-pinned trace target
+        (reshard_factor_a2a/_ring); no collective is added to any TRAINING
+        step program, so those budgets stay bitwise."""
         from harp_tpu.collectives import repartition as rep
+        from harp_tpu.collectives import reshard as rs
 
         layout, data, w0, h0, meta = state
         num_rows, num_cols, row_assign, col_assign, rpw, cpb = meta[:6]
@@ -942,14 +976,8 @@ class SGDMF:
                 "carries no world metadata (written by a pre-elastic "
                 "version?) — resume at the original worker count")
         old_world = int(ck_meta["world"])
-        if int(ck_meta.get("num_slices", 1)) != 1 \
-                or self.config.num_slices != 1:
-            raise ValueError(
-                "world-size-agnostic resume supports num_slices=1 only "
-                "(the 2-slice H layout interleaves worker-major "
-                f"half-slices); checkpoint has num_slices="
-                f"{ck_meta.get('num_slices')}, this config "
-                f"{self.config.num_slices}")
+        old_ns = int(ck_meta.get("num_slices", 1))
+        new_ns = self.config.num_slices
         if (int(ck_meta.get("num_rows", num_rows)) != num_rows
                 or int(ck_meta.get("num_cols", num_cols)) != num_cols):
             raise ValueError(
@@ -957,15 +985,56 @@ class SGDMF:
                 f"{ck_meta.get('num_rows')}x{ck_meta.get('num_cols')} "
                 f"rating matrix; this run prepared {num_rows}x{num_cols} — "
                 f"not the same dataset")
-        old_rpw = np.shape(saved["w"])[0] // old_world
-        old_cpb = np.shape(saved["h"])[0] // old_world
+        w = self.session.num_workers
+        saved_w = np.asarray(saved["w"])
+        saved_h = np.asarray(saved["h"])
+        old_rpw = saved_w.shape[0] // old_world
+        # 2-slice checkpoints hold H as fetched: worker-major
+        # (W_old, 2, cpb_old, K) — already flat device order when raveled
+        old_cpb = (saved_h.shape[2] if saved_h.ndim == 4
+                   else saved_h.shape[0] // (old_ns * old_world))
+        old_w_lay = rs.block_layout(
+            (np.asarray(saved["row_bin"]), np.asarray(saved["row_slot"])),
+            old_rpw, old_world, 1)
+        old_h_lay = rs.block_layout(
+            (np.asarray(saved["col_bin"]), np.asarray(saved["col_slot"])),
+            old_cpb, old_world, old_ns)
+        new_w_lay = rs.block_layout(row_assign, rpw, w, 1)
+        new_h_lay = rs.block_layout(col_assign, cpb, w, new_ns)
+        mode = self._reshard_mode()
+        if mode in ("device", "ring"):
+            schedule = "alltoall" if mode == "device" else "ring"
+            chunk = (self.config.reshard_chunk_bytes
+                     or rs.DEFAULT_CHUNK_BYTES)
+            w_new = rs.reshard_factor(
+                self.session, saved_w, old_w_lay, old_world, new_w_lay,
+                num_rows, w0, chunk_bytes=chunk, schedule=schedule)
+            h_new = rs.reshard_factor(
+                self.session, saved_h, old_h_lay, old_world, new_h_lay,
+                num_cols, h0, chunk_bytes=chunk, schedule=schedule)
+            return {**saved, "w": w_new, "h": h_new}
+        # host oracle: bin-major flat arrays on both sides (2-slice device
+        # order worker-major <-> bin-major via the half-slice transpose)
+        def to_bin_major(a):
+            return (a.transpose(1, 0, 2, 3).reshape(-1, a.shape[-1])
+                    if a.ndim == 4 else a)
+
+        def from_bin_major(flat, ns, w_, rpb):
+            if ns == 1:
+                return flat
+            k = flat.shape[-1]
+            return (flat.reshape(ns, w_, rpb, k).transpose(1, 0, 2, 3))
+
+        fill_h = to_bin_major(fetch(h0))
         w_new = rep.repartition_factor(
-            saved["w"], (saved["row_bin"], saved["row_slot"]), old_rpw,
+            saved_w, (saved["row_bin"], saved["row_slot"]), old_rpw,
             row_assign, rpw, num_rows, fetch(w0))
         h_new = rep.repartition_factor(
-            saved["h"], (saved["col_bin"], saved["col_slot"]), old_cpb,
-            col_assign, cpb, num_cols, fetch(h0))
-        return {**saved, "w": w_new, "h": h_new}
+            to_bin_major(saved_h),
+            (saved["col_bin"], saved["col_slot"]), old_cpb,
+            col_assign, cpb, num_cols, fill_h)
+        return {**saved, "w": w_new,
+                "h": from_bin_major(h_new, new_ns, w, cpb)}
 
     def fit(self, rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
             num_rows: int, num_cols: int, seed: int = 0

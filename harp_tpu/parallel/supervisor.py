@@ -184,6 +184,34 @@ def _straggler_suspects(telemetry_dir: Optional[str]) -> Optional[dict]:
             "report_ts": report.get("ts")}
 
 
+def straggler_ranks(telemetry_dir: Optional[str],
+                    world: Optional[int] = None,
+                    max_age_s: Optional[float] = None) -> List[int]:
+    """Ranks the published gang straggler report names (``suspects`` ∪
+    ``bsp_suspects``), bounded to ``world`` when given — the ONE shared
+    report→ranks reading for both responses to a slow host: the
+    supervisor's ``drop_stragglers`` EVICTION (relaunch one member smaller
+    / on a spare) and the serving layer's non-disruptive alternative
+    (``serve.endpoints.rebalance_from_report`` — slide the straggler's KV
+    shards to healthy workers on the mesh, restart nothing). Empty when no
+    report is published, or — with ``max_age_s`` — when the report's
+    timestamp is missing or older than that bound: a dead gang's stale
+    file must not drive a placement change, the same trust rule the
+    drop_stragglers strike accounting applies (report_ts >= attempt
+    start)."""
+    info = _straggler_suspects(telemetry_dir)
+    if not info:
+        return []
+    if max_age_s is not None:
+        ts = info.get("report_ts")
+        if not isinstance(ts, (int, float)) \
+                or time.time() - float(ts) > max_age_s:
+            return []
+    ranks = sorted(set(info.get("suspects") or [])
+                   | set(info.get("bsp_suspects") or []))
+    return [int(r) for r in ranks if world is None or 0 <= int(r) < world]
+
+
 def _resumed_step(checkpoint_dir: Optional[str]) -> Optional[int]:
     if not checkpoint_dir:
         return None

@@ -48,7 +48,8 @@ from harp_tpu.serve.endpoints import (ClassifyEndpoint, Endpoint,
                                       TopKEndpoint, classify_from_forest,
                                       classify_from_linear_svm,
                                       classify_from_multiclass_svm,
-                                      classify_from_nn)
+                                      classify_from_nn,
+                                      rebalance_from_report)
 from harp_tpu.serve.protocol import (OP_CLASSIFY, OP_TOPK, ServeError,
                                      make_reply, make_request)
 from harp_tpu.serve.router import RouterClient, ServeWorker, local_gang
@@ -58,5 +59,5 @@ __all__ = [
     "RouterClient", "ServeError", "ServeWorker", "TopKEndpoint",
     "classify_from_forest", "classify_from_linear_svm",
     "classify_from_multiclass_svm", "classify_from_nn", "local_gang",
-    "make_reply", "make_request",
+    "make_reply", "make_request", "rebalance_from_report",
 ]

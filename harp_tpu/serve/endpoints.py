@@ -36,6 +36,7 @@ the coalesced batch. ``trace_counts`` counts actual traces per bucket
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -69,6 +70,12 @@ class Endpoint:
         self._fns: Dict[int, object] = {}        # bucket -> compiled dispatch
         self.trace_counts: Dict[int, int] = {}   # bucket -> actual traces
         self._state: tuple = ()                  # resident device args
+        # (fn, state) must be read as a PAIR: live reshaping operations
+        # (TopKEndpoint.rebalance/restore_shard) replace _state and rebuild
+        # _fns while batcher threads dispatch — this lock makes the swap
+        # and the prepared() snapshot atomic, so a dispatch never pairs the
+        # old program with the new state (or vice versa)
+        self._resident_lock = threading.Lock()
 
     @property
     def max_batch(self) -> int:
@@ -116,12 +123,16 @@ class Endpoint:
 
     def prepared(self, batch) -> Tuple[object, tuple, int, int]:
         """(compiled fn, full arg tuple, n, bucket) for a request batch —
-        the dispatch surface, also what the jaxlint trace target traces."""
+        the dispatch surface, also what the jaxlint trace target traces.
+        The (fn, state) pair is snapshotted under the resident lock so a
+        concurrent rebalance/restore can never hand a dispatch the old
+        program with the new state."""
         n = len(batch)
         bucket = self.bucket_for(n)
-        fn = self.compiled(bucket)
-        return fn, self._state + (self._place_query(batch, bucket),), n, \
-            bucket
+        with self._resident_lock:
+            fn = self.compiled(bucket)
+            state = self._state
+        return fn, state + (self._place_query(batch, bucket),), n, bucket
 
     def dispatch(self, batch) -> List:
         """Serve one coalesced batch; returns one result per input row."""
@@ -331,21 +342,153 @@ class TopKEndpoint(Endpoint):
         if len(ids) and (ids.min() < 0 or ids.max() >= keyval.EMPTY):
             raise ValueError(f"user ids must be in [0, {keyval.EMPTY})")
         w = session.num_workers
-        owner = ids % w
-        counts = np.bincount(owner, minlength=w)
-        cap = max(int(counts.max()), 1)
-        keys = np.full((w, cap), keyval.EMPTY, np.int32)
-        vals = np.zeros((w, cap, uf.shape[1]), np.float32)
-        for wid in range(w):
-            mine = np.flatnonzero(owner == wid)
-            mine = mine[np.argsort(ids[mine], kind="stable")]
-            keys[wid, : len(mine)] = ids[mine]
-            vals[wid, : len(mine)] = uf[mine]
         self.k = min(int(k), items.shape[0])
         self.num_items = items.shape[0]
+        self._ids = ids.astype(np.int64)         # host index arrays only —
+        self._owner = (ids % w).astype(np.int64)  # the shard map, not data
+        self._owner_routed = False
+        self._dim = uf.shape[1]
+        slot, counts, cap = self._kv_layout(self._owner)
+        self._slot, self._counts, self._cap = slot, counts, cap
+        keys = np.full((w, cap), keyval.EMPTY, np.int32)
+        vals = np.zeros((w, cap, uf.shape[1]), np.float32)
+        keys[self._owner, slot] = ids
+        vals[self._owner, slot] = uf
         self._state = (session.scatter(keys), session.scatter(vals),
                        session.scatter(counts.astype(np.int32)),
                        session.replicate_put(items))
+
+    # -- shard bookkeeping (restore / rebalance ride collectives.reshard) -- #
+
+    def _kv_layout(self, owner: np.ndarray
+                   ) -> Tuple[np.ndarray, np.ndarray, int]:
+        """(slot, per-worker counts, capacity) of the sorted per-worker
+        stores under an owner map — slots order by id within each worker,
+        which is the KVStore sorted-keys invariant."""
+        w = self.session.num_workers
+        n = len(self._ids)
+        order = np.lexsort((self._ids, owner))
+        counts = np.bincount(owner, minlength=w)
+        starts = np.concatenate([[0], np.cumsum(counts)])
+        slot = np.empty(n, np.int64)
+        slot[order] = np.arange(n) - starts[owner[order]]
+        return slot, counts, max(int(counts.max(initial=0)), 1)
+
+    def _keys_counts(self, owner, slot, counts, cap):
+        keys = np.full((self.session.num_workers, cap), keyval.EMPTY,
+                       np.int32)
+        keys[owner, slot] = self._ids
+        return (self.session.scatter(keys),
+                self.session.scatter(counts.astype(np.int32)))
+
+    def restore_shard(self, rank: int, user_factors) -> int:
+        """Rebuild ONE worker's lost KV shard from the canonical factor
+        table (a training checkpoint / the concurrently-training gang's
+        snapshot) WITHOUT touching the rest of the gang's live state — the
+        serving-grade recovery primitive the ROADMAP fleet item names: a
+        spare that took over ``rank`` receives exactly that shard while
+        every other worker keeps answering. The replacement rows ride the
+        reshard engine's chunk-bounded all_to_all rounds straight from the
+        contiguous canonical leaf into rank's (slot) rows; all other
+        workers' rows are the engine's FILL and come through bitwise
+        untouched (no host gather of the live sharded store). Returns the
+        number of factor rows restored."""
+        from harp_tpu.collectives import reshard as rs
+
+        sess = self.session
+        w = sess.num_workers
+        if not 0 <= int(rank) < w:
+            raise ValueError(f"rank {rank} outside the {w}-worker gang")
+        uf = np.asarray(user_factors, np.float32)
+        if uf.shape != (len(self._ids), self._dim):
+            raise ValueError(
+                f"canonical factors must be ({len(self._ids)}, "
+                f"{self._dim}) in the endpoint's id order; got {uf.shape}")
+        mine = np.flatnonzero(self._owner == int(rank))
+        # the resident lock covers the whole move: dispatches pause for the
+        # restore instead of racing a half-written shard or pairing the
+        # old program with the new state
+        with self._resident_lock:
+            keys_d, vals_d, counts_d, items = self._state[:4]
+            plan = rs.plan_moves(
+                mine, self._owner[mine] * self._cap + self._slot[mine],
+                len(uf), w * self._cap, w, self._dim * 4)
+            new_vals = rs.reshard(sess, uf, plan, vals_d)
+            # the key/count rows are host-known index arrays — re-scatter
+            # them whole (tiny); only the factor payload needed the engine
+            keys, counts = self._keys_counts(self._owner, self._slot,
+                                             self._counts, self._cap)
+            self._state = (keys, new_vals, counts, items) + self._state[4:]
+        return len(mine)
+
+    def rebalance(self, away_from) -> dict:
+        """Move this endpoint's KV shards OFF the given rank(s) — the
+        PR 7 straggler report's non-disruptive remedy: ids owned by a slow
+        worker are re-assigned to the least-loaded healthy workers
+        (water-filling), the factor rows move between workers ON the mesh
+        through the reshard engine's bounded rounds (the live store is the
+        engine's source — zero host involvement for the payload), and the
+        dispatch switches to owner-map routing
+        (``DistributedKV.lookup(dest=...)`` — same 3 all_to_alls, pinned
+        by the ``serve_topk_mf_rebalanced`` trace target). Nothing
+        restarts: the per-bucket dispatches recompile lazily on their next
+        request. Returns ``{"moved": rows, "owners": per-rank counts}``."""
+        import heapq
+
+        from harp_tpu.collectives import reshard as rs
+
+        sess = self.session
+        w = sess.num_workers
+        away = sorted({int(r) for r in (
+            away_from if np.iterable(away_from) else [away_from])})
+        if any(not 0 <= r < w for r in away):
+            raise ValueError(f"ranks {away} outside the {w}-worker gang")
+        targets = [r for r in range(w) if r not in away]
+        if not targets:
+            raise ValueError(
+                f"rebalance away from {away} would leave no worker owning "
+                f"any shard — at least one rank must stay")
+        span = int(self._ids.max(initial=0)) + 1
+        if span > max(4 * len(self._ids), 1 << 20):
+            raise ValueError(
+                f"owner-map routing needs a dense-ish id space: max id "
+                f"{span - 1} vs {len(self._ids)} ids — remap ids before "
+                f"serving if rebalancing is needed")
+        owner = self._owner.copy()
+        victims = np.flatnonzero(np.isin(owner, away))
+        heap = [(int(np.sum(owner[~np.isin(owner, away)] == r)), r)
+                for r in targets]
+        heapq.heapify(heap)
+        for v in victims:
+            load, r = heapq.heappop(heap)
+            owner[v] = r
+            heapq.heappush(heap, (load + 1, r))
+        slot, counts, cap = self._kv_layout(owner)
+        # the resident lock covers the move AND the (state, fns) swap:
+        # in-flight dispatches finish on the old pair, later ones see the
+        # owner-routed pair — never a mix
+        with self._resident_lock:
+            keys_d, vals_d, counts_d, items = self._state[:4]
+            # every row may shift slots, so the whole store reshards —
+            # source is the LIVE device array (flat order owner*cap + slot)
+            plan = rs.plan_moves(
+                self._owner * self._cap + self._slot, owner * cap + slot,
+                w * self._cap, w * cap, w, self._dim * 4)
+            fill = sess.scatter(np.zeros((w, cap, self._dim), np.float32))
+            new_vals = rs.reshard(sess, vals_d, plan, fill)
+            self._owner, self._slot, self._counts, self._cap = (owner, slot,
+                                                                counts, cap)
+            owner_map = (np.arange(span, dtype=np.int64) % w).astype(
+                np.int32)
+            owner_map[self._ids] = owner
+            keys, counts_dev = self._keys_counts(owner, slot, counts, cap)
+            self._state = (keys, new_vals, counts_dev, items,
+                           sess.replicate_put(owner_map))
+            self._owner_routed = True
+            self._fns.clear()    # owner-routed dispatch is a new program
+        moved = int(plan.moved_rows)
+        return {"moved": moved,
+                "owners": {int(r): int(c) for r, c in enumerate(counts)}}
 
     def _validate_data(self, data) -> Optional[str]:
         if np.ndim(data) != 0:
@@ -362,6 +505,41 @@ class TopKEndpoint(Endpoint):
     def _build(self, bucket: int):
         sess = self.session
         k = self.k
+        w = sess.num_workers
+
+        def score_topk(w_q, found, items):
+            scores = jax.lax.dot_general(
+                w_q, items, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            scores = jnp.where(found[:, None], scores,
+                               jnp.finfo(jnp.float32).min)
+            top_v, top_i = jax.lax.top_k(scores, k)
+            return top_i.astype(jnp.int32), top_v, found
+
+        if self._owner_routed:
+            def topk_routed(keys, vals, count, items, owner, q):
+                self._count_trace(bucket)
+                store = keyval.KVStore(keys[0], vals[0], count[0])
+                # explicit owner-map routing (post-rebalance): known ids
+                # route to their moved shard, out-of-span/padding ids fall
+                # back to the modulo (they answer found=False either way).
+                # Same 3 all_to_alls as the modulo dispatch — pinned by
+                # the serve_topk_mf_rebalanced trace target.
+                n_ids = owner.shape[0]
+                in_span = (q >= 0) & (q < n_ids)
+                dest = jnp.where(in_span,
+                                 owner[jnp.clip(q, 0, n_ids - 1)],
+                                 q % w)
+                w_q, found = keyval.DistributedKV(store).lookup(
+                    q, route_cap=q.shape[0], dest=dest)
+                return score_topk(w_q, found, items)
+
+            return sess.spmd(
+                topk_routed,
+                in_specs=(sess.shard(), sess.shard(), sess.shard(),
+                          sess.replicate(), sess.replicate(), sess.shard()),
+                out_specs=(sess.shard(),) * 3,
+                donate_argnums=(5,))
 
         def topk(keys, vals, count, items, q):
             self._count_trace(bucket)
@@ -370,13 +548,7 @@ class TopKEndpoint(Endpoint):
             # route_cap = the full local batch — any owner skew fits.
             w_q, found = keyval.DistributedKV(store).lookup(
                 q, route_cap=q.shape[0])
-            scores = jax.lax.dot_general(
-                w_q, items, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            scores = jnp.where(found[:, None], scores,
-                               jnp.finfo(jnp.float32).min)
-            top_v, top_i = jax.lax.top_k(scores, k)
-            return top_i.astype(jnp.int32), top_v, found
+            return score_topk(w_q, found, items)
 
         return sess.spmd(
             topk,
@@ -404,3 +576,26 @@ class TopKEndpoint(Endpoint):
             else:
                 rows.append({"found": False, "items": [], "scores": []})
         return rows
+
+
+def rebalance_from_report(endpoint: TopKEndpoint, telemetry_dir: str,
+                          max_age_s: Optional[float] = 600.0) -> List[int]:
+    """Move a :class:`TopKEndpoint`'s shards off every rank the PR 7 gang
+    straggler report names — the ``rebalance()`` entry point driven by the
+    published telemetry (``straggler_report.json``): where the supervisor's
+    ``drop_stragglers`` policy EVICTS the slow rank and relaunches, a
+    serving gang just slides its shards to the healthy workers and keeps
+    answering. Returns the ranks it moved away from ([] when no report is
+    published, the report is older than ``max_age_s`` — a dead gang's
+    stale file earns no shard migration, the same trust rule the
+    supervisor's strike accounting applies; pass ``None`` to accept any
+    age — no rank is flagged, or the report flags the whole gang, which
+    is a measurement artifact, not a placement fix)."""
+    from harp_tpu.parallel.supervisor import straggler_ranks
+
+    w = endpoint.session.num_workers
+    ranks = straggler_ranks(telemetry_dir, world=w, max_age_s=max_age_s)
+    if not ranks or len(ranks) >= w:
+        return []
+    endpoint.rebalance(ranks)
+    return ranks

@@ -202,6 +202,36 @@ CLAIMS: List[Claim] = [
           r"Serve top-k lookup \(serve_topk_mf\) \| (\S+) B",
           ("targets", "serve_topk_mf", "bytes_per_step"),
           rel_tol=0.0, file="tools/collective_budget.json"),
+    # README "On-device resharding" + PERF.md r12 (ISSUE 11): the measured
+    # CPU-mesh reshard row (the on-chip GB-scale re-measure rewrites the
+    # record AND this prose, by design) plus the traced per-round byte pins
+    # — the bounded-round contract: a schedule degrading toward a full
+    # gather grows these exact numbers and fails jaxlint first, this table
+    # second.
+    Claim("reshard_seconds", "README.md",
+          r"W4→W8 world change in (\S+) s",
+          ("reshard", "cpu_mesh", "reshard_seconds")),
+    Claim("reshard_speedup", "README.md",
+          r"(\S+)× the host gather-and-resplit",
+          ("reshard", "cpu_mesh", "host_vs_device_speedup")),
+    Claim("reshard_perf_seconds", "PERF.md",
+          r"\| device all_to_all rounds \| (\S+) s",
+          ("reshard", "cpu_mesh", "reshard_seconds")),
+    Claim("reshard_perf_host_seconds", "PERF.md",
+          r"\| host gather-and-resplit \| (\S+) s",
+          ("reshard", "cpu_mesh", "host_gather_seconds")),
+    Claim("comm_reshard_a2a", "PERF.md",
+          r"Reshard round \(reshard_factor_a2a\) \| (\S+) B",
+          ("targets", "reshard_factor_a2a", "bytes_per_step"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("comm_reshard_ring", "PERF.md",
+          r"Reshard ring schedule \(reshard_factor_ring\) \| (\S+) B",
+          ("targets", "reshard_factor_ring", "bytes_per_step"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("comm_topk_rebalanced", "PERF.md",
+          r"Rebalanced top-k lookup \(serve_topk_mf_rebalanced\) \| (\S+) B",
+          ("targets", "serve_topk_mf_rebalanced", "bytes_per_step"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
 ]
 
 

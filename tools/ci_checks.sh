@@ -22,7 +22,19 @@
 #                     sneaking into the resident predict dispatch, or its
 #                     bytes growing, fails JL201/JL203; the one-compile-
 #                     per-(model,bucket) retrace contract is asserted by
-#                     tests/test_serve.py in stage 4);
+#                     tests/test_serve.py in stage 4.
+#                     r12: the manifest also pins the ON-DEVICE RESHARD
+#                     step programs (collectives/reshard.py):
+#                     reshard_factor_a2a at ONE all_to_all whose operand
+#                     bytes ARE the per-round chunk budget (512 B at the
+#                     traced shape), reshard_factor_ring at the per-shift
+#                     ppermute schedule, and serve_topk_mf_rebalanced at
+#                     the SAME 3 all_to_alls as serve_topk_mf — a reshard
+#                     schedule silently degrading toward a full gather,
+#                     or a rebalance adding a collective to the request
+#                     path, fails JL201/JL203; bitwise parity vs the
+#                     numpy oracle is asserted by tests/test_reshard.py
+#                     in stage 4);
 #                     nonzero on any finding or stale allowlist entry.
 #   2. telemetry    — the jaxpr engine re-run with the gang telemetry layer
 #                     ENABLED (HARP_TELEMETRY_DIR set): the instrumented

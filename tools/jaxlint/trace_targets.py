@@ -206,6 +206,54 @@ def _serve_topk():
     return fn, args
 
 
+def _serve_topk_rebalanced():
+    from harp_tpu.serve import endpoints as serve_ep
+
+    sess = _session()
+    rng = _rng()
+    uf = rng.normal(size=(64, 8)).astype("float32")
+    items = rng.normal(size=(32, 8)).astype("float32")
+    ep = serve_ep.TopKEndpoint(sess, "mf", uf, items, k=4)
+    ep.rebalance(1)       # owner-map routed dispatch (ISSUE 11 rebalance)
+    ids = rng.integers(0, 64, size=ep.bucket_sizes[0])
+    fn, args, _n, _bucket = ep.prepared(ids)
+    return fn, args
+
+
+def _reshard(schedule: str):
+    def build():
+        import numpy as np
+
+        from harp_tpu.collectives import reshard as rs
+        from harp_tpu.models.sgd_mf import identity_assign, serpentine_assign
+
+        sess = _session()
+        rng = _rng()
+        # a W=4 checkpointed factor table re-sharded onto the 8-worker
+        # tracing mesh: serpentine old maps, identity new maps, 97 valid
+        # rows (prime — the padded-slot edge is in the traced program) and
+        # a 512 B chunk budget so the schedule runs MULTIPLE rounds: the
+        # pinned bytes-per-step row IS the per-round foreign footprint,
+        # which a schedule degrading toward a full gather would grow.
+        n, r = 97, 8
+        old_world, old_rpb, new_rpb = 4, 28, 16
+        old = rs.block_layout(
+            serpentine_assign(rng.integers(1, 9, n), old_world), old_rpb,
+            old_world)
+        new = rs.block_layout(identity_assign(n, NUM_WORKERS), new_rpb,
+                              NUM_WORKERS)
+        saved = rng.standard_normal(
+            (old_world * old_rpb, r)).astype("float32")
+        fill = sess.scatter(np.zeros((NUM_WORKERS * new_rpb, r),
+                                     np.float32))
+        plan = rs.plan_factor_reshard(old, old_world, new, NUM_WORKERS, n,
+                                      r * 4, chunk_bytes=512,
+                                      schedule=schedule)
+        return rs.prepare_reshard(sess, saved, plan, fill)
+
+    return build
+
+
 # Registry: target name -> builder returning (traceable callable, args).
 # Names are the manifest keys — renaming one is a manifest change.
 # The *_int8/*_bf16 rows pin the QUANTIZED step programs: their byte rows
@@ -250,4 +298,17 @@ TARGETS: Dict[str, Callable[[], Tuple[Callable, tuple]]] = {
     "nn_mlp": _nn,
     "serve_classify_nn": _serve_classify,
     "serve_topk_mf": _serve_topk,
+    # r12 (ISSUE 11): the on-device reshard step programs. The a2a row pins
+    # ONE all_to_all per round whose operand bytes ARE the per-round
+    # foreign-row budget (chunk_bytes at the traced shape) — a schedule
+    # silently degrading toward a full gather (bigger rounds, or a
+    # fall-back all_gather) changes kinds/bytes and fails JL201/JL203. The
+    # ring row pins the per-shift ppermute schedule (rides lax_ops.rotate,
+    # so DCN chunking composes). serve_topk_mf_rebalanced pins the
+    # owner-map-routed serving dispatch a rebalance() switches to: the
+    # SAME 3 all_to_alls as serve_topk_mf — rebalancing moves shards, it
+    # must never add a collective to the request path.
+    "reshard_factor_a2a": _reshard("alltoall"),
+    "reshard_factor_ring": _reshard("ring"),
+    "serve_topk_mf_rebalanced": _serve_topk_rebalanced,
 }
