@@ -975,15 +975,21 @@ def tpu_serving(small=False):
     telemetry.configure(tele_dir, interval=1)
     try:
         row = serving_load.measure(
-            sess, requests_per_mix=300 if small else 900, num_clients=3)
+            sess, requests_per_mix=300 if small else 900, num_clients=3,
+            trace_sample=4)
     finally:
         telemetry.disable()
     rank_file = os.path.join(tele_dir, "rank0", "steps.jsonl")
-    n_events = 0
+    n_events = n_spans = 0
     if os.path.exists(rank_file):
         with open(rank_file) as f:
-            n_events = sum(1 for line in f if '"kind": "timing"' in line)
+            for line in f:
+                n_events += '"kind": "timing"' in line
+                n_spans += '"kind": "span"' in line
     row["telemetry_timing_events"] = n_events
+    # the r13 proof the spans flowed THROUGH telemetry: every sampled
+    # request's breakdown is also a kind:"span" JSONL event
+    row["telemetry_span_events"] = n_spans
     row["telemetry_dir"] = tele_dir
     return row
 
@@ -1523,6 +1529,13 @@ def main():
                 "serving_mixed_p99_ms": mixed.get("p99_ms"),
                 "serving_mixed_qps": mixed.get("qps"),
                 "serving_device": srow.get("device")})
+            rec = srow.get("reconciliation") or {}
+            sb = srow.get("stage_breakdown") or {}
+            compact.update({
+                "serving_dispatch_p50_ms": sb.get("dispatch",
+                                                  {}).get("p50_ms"),
+                "serving_span_p50_ratio": rec.get("p50_ratio"),
+                "serving_span_mean_ratio": rec.get("mean_ratio")})
 
     if want("reshard"):
         begin("reshard")

@@ -30,6 +30,16 @@ each mix row is also published into ``steps.jsonl`` via
 the batcher's occupancy/batch-size gauges land in the shared metrics
 registry.
 
+Observability plane (r13): every Nth request is TRACED
+(``trace_sample``, through :mod:`harp_tpu.telemetry.spans`) and the row
+gains ``stage_breakdown`` (per-stage p50/p99/mean over the sampled spans
+— the six stages partition each span's end-to-end latency) plus
+``reconciliation`` (stage sums vs the measured end-to-end: the mean ratio
+is ~1.0 by construction, the p50 ratio is checked within a stated 25%
+band), ``lookup_skew`` (the TopK endpoint's per-owner histogram), and a
+per-mix ``deadline_expired`` count (``deadline_s`` attaches deadlines to
+every request so expiry behavior is measurable).
+
 Latency on a CPU-mesh session prices the ROUTER + BATCHER + dispatch stack
 with CPU dispatch times; the driver's on-chip ``bench.py --only serving``
 re-measures with real TPU dispatches (the row carries ``device`` so the two
@@ -58,7 +68,8 @@ TOPK_MODEL = "topk"
 def build_gang(session, *, num_users: int = 512, num_items: int = 256,
                rank: int = 8, k: int = 10, classify_dim: int = 16,
                num_classes: int = 3, max_wait_s: float = 0.002,
-               seed: int = 0, metrics=None):
+               seed: int = 0, metrics=None, trace_sample: int = 0,
+               slo_p99_s=None, slo_kw=None):
     """A 2-worker serving gang over synthetic trained state.
 
     Returns ``(workers, make_client, meta)`` — ``meta`` carries the
@@ -77,10 +88,11 @@ def build_gang(session, *, num_users: int = 512, num_items: int = 256,
     user_factors = rng.normal(size=(num_users, rank)).astype(np.float32)
     item_factors = rng.normal(size=(num_items, rank)).astype(np.float32)
     ep_topk = TopKEndpoint(session, TOPK_MODEL, user_factors, item_factors,
-                           k=k)
+                           k=k, metrics=metrics)
     workers, make_client = local_gang(
         session, [{CLASSIFY_MODEL: ep_classify}, {TOPK_MODEL: ep_topk}],
-        max_wait_s=max_wait_s, metrics=metrics)
+        max_wait_s=max_wait_s, metrics=metrics, trace_sample=trace_sample,
+        slo_p99_s=slo_p99_s, slo_kw=slo_kw)
     meta = {"num_users": num_users, "num_items": num_items, "rank": rank,
             "k": k, "classify_dim": classify_dim,
             "endpoints": {CLASSIFY_MODEL: ep_classify, TOPK_MODEL: ep_topk}}
@@ -89,7 +101,8 @@ def build_gang(session, *, num_users: int = 512, num_items: int = 256,
 
 def _client_loop(client, n_requests: int, topk_fraction: float, meta: dict,
                  seed: int, metrics, timer_name: str, errors: list,
-                 barrier: threading.Barrier, timeout: float) -> None:
+                 barrier: threading.Barrier, timeout: float,
+                 deadline_s: Optional[float] = None) -> None:
     rng = np.random.default_rng(seed)
     from harp_tpu.serve import OP_CLASSIFY, OP_TOPK
 
@@ -105,7 +118,10 @@ def _client_loop(client, n_requests: int, topk_fraction: float, meta: dict,
             op, model = OP_CLASSIFY, CLASSIFY_MODEL
         t0 = time.perf_counter()
         try:
-            client.request(op, model, data, timeout=timeout)
+            deadline_ts = (time.time() + deadline_s
+                           if deadline_s is not None else None)
+            client.submit(op, model, data,
+                          deadline_ts=deadline_ts).result(timeout)
         except Exception as e:
             # the load thread records ANY per-request failure (ServeError,
             # timeout, transport error) and keeps the mix running; failures
@@ -119,12 +135,22 @@ def _client_loop(client, n_requests: int, topk_fraction: float, meta: dict,
 def measure(session=None, *, requests_per_mix: int = 900,
             num_clients: int = 3, mixes: Optional[Dict[str, float]] = None,
             max_wait_s: float = 0.002, request_timeout: float = 60.0,
-            seed: int = 0) -> dict:
-    """Run every mix; returns the bench row (see module docstring)."""
+            seed: int = 0, trace_sample: int = 4,
+            deadline_s: Optional[float] = None) -> dict:
+    """Run every mix; returns the bench row (see module docstring).
+
+    ``trace_sample=N`` traces every Nth request through telemetry.spans
+    (0 = off): the per-stage breakdown row and its end-to-end
+    reconciliation come from those spans. ``deadline_s`` attaches a
+    deadline to every request; expired ones are counted per mix
+    (``deadline_expired``) so a client can see its deadline vs the
+    coalescing window."""
     import jax
 
     from harp_tpu import telemetry
     from harp_tpu.serve import OP_CLASSIFY, OP_TOPK
+    from harp_tpu.serve import protocol as serve_protocol
+    from harp_tpu.telemetry import spans
     from harp_tpu.utils.metrics import Metrics
 
     if session is None:
@@ -134,8 +160,14 @@ def measure(session=None, *, requests_per_mix: int = 900,
     mixes = dict(DEFAULT_MIXES if mixes is None else mixes)
     metrics = Metrics()          # fresh registry: reservoirs are per-run
     workers, make_client, meta = build_gang(
-        session, max_wait_s=max_wait_s, metrics=metrics, seed=seed)
-    clients = [make_client() for _ in range(num_clients)]
+        session, max_wait_s=max_wait_s, metrics=metrics, seed=seed,
+        trace_sample=trace_sample)
+    # span timers are observed by each client's RECEIVE thread — one
+    # registry per client (TimerReservoir.add is unsynchronized), merged
+    # serially after the mixes, same rule as the load threads below
+    span_regs = [Metrics() for _ in range(num_clients)]
+    clients = [make_client(span_metrics=span_regs[i])
+               for i in range(num_clients)]
     rows: Dict[str, dict] = {}
     try:
         # warmup, two layers: (1) compile EVERY bucket a closed loop of
@@ -156,10 +188,20 @@ def measure(session=None, *, requests_per_mix: int = 900,
                     ep.dispatch(np.zeros(
                         (bucket, meta["classify_dim"]), np.float32))
         for c in clients:
-            c.request(OP_TOPK, TOPK_MODEL, 0, timeout=request_timeout)
-            c.request(OP_CLASSIFY, CLASSIFY_MODEL,
-                      np.zeros(meta["classify_dim"], np.float32),
-                      timeout=request_timeout)
+            # warmup requests run UNTRACED: the first request per client
+            # pays transport connect + add_peer, and that setup cost must
+            # not land in the measured span percentiles
+            sample = c.trace_sample
+            c.trace_sample = 0
+            try:
+                c.request(OP_TOPK, TOPK_MODEL, 0, timeout=request_timeout)
+                c.request(OP_CLASSIFY, CLASSIFY_MODEL,
+                          np.zeros(meta["classify_dim"], np.float32),
+                          timeout=request_timeout)
+            finally:
+                c.trace_sample = sample
+        # warmup queried id 0 everywhere — it must not read as a hot key
+        meta["endpoints"][TOPK_MODEL].reset_lookup_skew()
         for mix, frac in mixes.items():
             timer = f"serve.latency.{mix}"
             per_client = max(1, requests_per_mix // num_clients)
@@ -175,7 +217,7 @@ def measure(session=None, *, requests_per_mix: int = 900,
                 target=_client_loop,
                 args=(c, per_client, frac, meta, seed + 100 + i,
                       thread_regs[i], timer, errors, barrier,
-                      request_timeout),
+                      request_timeout, deadline_s),
                 name=f"harp-serve-load-{mix}-{i}", daemon=True)
                 for i, c in enumerate(clients)]
             for t in threads:
@@ -188,17 +230,18 @@ def measure(session=None, *, requests_per_mix: int = 900,
             done = 0
             for reg in thread_regs:
                 tr = reg.timers.get(timer)
-                if tr is None:
-                    continue
-                done += tr.count          # exact, even past the sample cap
-                for v in tr.samples:
-                    metrics.observe(timer, v)
+                if tr is not None:
+                    done += tr.count      # exact, even past the sample cap
+                metrics.merge(reg)        # reservoir-merged, count exact
             timing = metrics.timing(timer)
             rows[mix] = {
                 "topk_fraction": frac,
                 "requests": done,
                 "errors": len(errors),
                 "error_sample": errors[:3],
+                "deadline_expired": sum(
+                    1 for e in errors
+                    if serve_protocol.ERR_DEADLINE in e),
                 "qps": round(done / wall, 1) if wall > 0 else None,
                 "p50_ms": round(timing["p50_s"] * 1e3, 3) if timing else None,
                 "p99_ms": round(timing["p99_s"] * 1e3, 3) if timing else None,
@@ -221,6 +264,50 @@ def measure(session=None, *, requests_per_mix: int = 900,
                 "trace_counts": dict(
                     meta["endpoints"][name].trace_counts),
             }
+        # per-stage breakdown from the sampled spans (whole run, all
+        # mixes): the six stage durations PARTITION each span's end-to-end
+        # latency exactly, so the stage MEAN sum reconciles with the span
+        # mean to float noise; percentile sums are sub/super-additive
+        # across differently-skewed stages, so the p50 ratio is checked
+        # against a stated 25% band rather than equality
+        for reg in span_regs:
+            metrics.merge(reg)
+        stage_breakdown = {}
+        for stage in ("total",) + spans.STAGES:
+            t = metrics.timing(f"serve.span.{stage}")
+            if t:
+                stage_breakdown[stage] = {
+                    "p50_ms": round(t["p50_s"] * 1e3, 3),
+                    "p99_ms": round(t["p99_s"] * 1e3, 3),
+                    "mean_ms": round(t["mean_s"] * 1e3, 3),
+                    "count": t["count"]}
+        reconciliation = None
+        if "total" in stage_breakdown and all(
+                s in stage_breakdown for s in spans.STAGES):
+            stage_p50_sum = sum(stage_breakdown[s]["p50_ms"]
+                                for s in spans.STAGES)
+            stage_mean_sum = sum(stage_breakdown[s]["mean_ms"]
+                                 for s in spans.STAGES)
+            tot = stage_breakdown["total"]
+            reconciliation = {
+                "spans": tot["count"],
+                "span_p50_ms": tot["p50_ms"],
+                "stage_p50_sum_ms": round(stage_p50_sum, 3),
+                "p50_ratio": round(stage_p50_sum / tot["p50_ms"], 4)
+                if tot["p50_ms"] else None,
+                "span_mean_ms": tot["mean_ms"],
+                "stage_mean_sum_ms": round(stage_mean_sum, 3),
+                "mean_ratio": round(stage_mean_sum / tot["mean_ms"], 4)
+                if tot["mean_ms"] else None,
+                "note": "stage durations partition each span exactly; "
+                        "mean_ratio ~ 1.0 by construction, p50_ratio "
+                        "checked within 25% (percentiles are not "
+                        "additive across stages)",
+            }
+            telemetry.record_timing("serve.span.total", metrics=metrics,
+                                    extra={"stage_p50_sum_ms":
+                                           round(stage_p50_sum, 3)})
+        skew = meta["endpoints"][TOPK_MODEL].lookup_skew()
     finally:
         for c in clients:
             c.close()
@@ -230,10 +317,14 @@ def measure(session=None, *, requests_per_mix: int = 900,
               else jax.devices()[0].platform)
     row = {
         "gang": f"2 workers + {num_clients} closed-loop clients, "
-                f"loopback authenticated p2p, max_wait_s={max_wait_s}",
+                f"loopback authenticated p2p, max_wait_s={max_wait_s}, "
+                f"trace_sample={trace_sample}",
         "device": device,
         "mixes": rows,
         "batching": occupancy,
+        "stage_breakdown": stage_breakdown,
+        "reconciliation": reconciliation,
+        "lookup_skew": skew,
     }
     if device != "tpu":
         row["note"] = (

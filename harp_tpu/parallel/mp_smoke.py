@@ -260,6 +260,39 @@ def run(process_id: int, num_processes: int, port: int,
         lines = f.read().strip().splitlines()
     assert len(lines) == 6, len(lines)
 
+    # --- SLO watchdog (ISSUE 12 acceptance): on the LIVE gang, the slow
+    # rank's own watchdog burns on its dragged boundary walls and fires the
+    # PR 7 machinery exactly once — xprof trigger file armed, snapshot
+    # dumped, the straggler report (which names this rank) attached to the
+    # journaled incident — while every healthy rank's watchdog stays quiet.
+    # Purely local per rank (no collective), so unaligned firing is safe. #
+    import json as _json
+
+    from harp_tpu.telemetry.gang import write_straggler_report
+    from harp_tpu.telemetry.watchdog import SLOWatchdog
+
+    write_straggler_report(tele_dir, report)   # each rank's own telemetry
+    #                                            dir gets the gang's verdict
+    wd = SLOWatchdog(0.020, window_s=60.0, min_samples=3, sustain=2,
+                     telemetry_dir=tele_dir, rank=process_id)
+    hook = wd.boundary_hook()
+    os.environ["HARP_FAULT"] = "slow@epoch=1:rank=1:ms=60"
+    for step in range(6):
+        pfaults.fire(step + 1)
+        hook(step, telemetry.active())
+    os.environ.pop("HARP_FAULT", None)
+    if process_id == 1:
+        assert wd.incidents == 1, f"slow rank fired {wd.incidents}x"
+        with open(os.path.join(tele_dir, "slo_incidents.jsonl")) as f:
+            rec = _json.loads(f.read().strip().splitlines()[0])
+        assert rec["straggler_report"]["suspects"] == [1], rec
+        assert "xprof_request" in rec["triggered"], rec
+        assert os.path.exists(os.path.join(tele_dir, "xprof_request.json"))
+    else:
+        assert wd.incidents == 0, \
+            f"healthy rank {process_id} fired {wd.incidents}x"
+    multihost_utils.sync_global_devices("slo-watchdog-smoke-done")
+
     # xprof window: COLLECTIVE request (rank 0's payload wins — every rank
     # traces into a per-rank dir under rank 0's telemetry root), opened at
     # the next boundary, closed after 2 boundaries

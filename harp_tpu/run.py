@@ -68,6 +68,29 @@ def _common_flags(p: argparse.ArgumentParser) -> None:
                    help="telemetry cadence in CHUNK BOUNDARIES (count-based "
                         "so gang ranks stay aligned): flush + gang straggler "
                         "publish every N boundaries")
+    p.add_argument("--metrics-port", type=int, default=-1,
+                   help="start the per-process pull exporter "
+                        "(telemetry.exporter: /metrics Prometheus text, "
+                        "/snapshot JSON, /gang aggregated view in gang "
+                        "mode). 0 = ephemeral port (printed at startup), "
+                        ">0 = that port + this member's rank (same-host "
+                        "gang members never collide), negative = off.")
+    p.add_argument("--slo-p99-ms", type=float, default=0.0,
+                   help="arm the SLO watchdog (telemetry.watchdog) at this "
+                        "rolling p99 target over the CHUNK-BOUNDARY walls "
+                        "(compiled chunk + checkpoint + any host drag): on "
+                        "sustained burn it auto-arms an xprof window (the "
+                        "trigger-file path, every rank), dumps the "
+                        "straggler-format snapshot, and journals the "
+                        "incident under --telemetry-dir. 0 = off; requires "
+                        "--telemetry-dir.")
+    p.add_argument("--slo-window-s", type=float, default=30.0,
+                   help="SLO watchdog rolling-window length, seconds")
+    p.add_argument("--slo-error-budget", type=float, default=0.1,
+                   help="SLO watchdog tolerated error fraction over the "
+                        "window (the serving path feeds errors; training "
+                        "step walls are all ok=True, so only the p99 "
+                        "target fires there)")
 
 
 def _session(args):
@@ -100,16 +123,45 @@ def _session(args):
         n = len(jax.devices())
     sess = HarpSession(num_workers=min(n, len(jax.devices())))
     if getattr(args, "telemetry_dir", ""):
-        _enable_telemetry(sess, args.telemetry_dir, args.telemetry_interval)
+        _enable_telemetry(sess, args.telemetry_dir, args.telemetry_interval,
+                          slo_p99_ms=getattr(args, "slo_p99_ms", 0.0),
+                          slo_window_s=getattr(args, "slo_window_s", 30.0),
+                          slo_error_budget=getattr(args, "slo_error_budget",
+                                                   0.1),
+                          metrics_port=getattr(args, "metrics_port", -1))
+    elif getattr(args, "metrics_port", -1) >= 0:
+        # the exporter is useful without the JSONL layer (scrape-only runs)
+        _start_exporter(getattr(args, "metrics_port", -1), collector=None)
     return sess
 
 
-def _enable_telemetry(sess, directory: str, interval: int) -> None:
+def _start_exporter(metrics_port: int, collector):
+    from harp_tpu.telemetry.exporter import MetricsExporter
+
+    rank = int(os.environ.get("HARP_PROCESS_ID", "0"))
+    port = metrics_port + rank if metrics_port > 0 else 0
+    exporter = MetricsExporter(
+        port=port, rank=rank,
+        gang=(lambda: collector.last_snapshots) if collector is not None
+        else None)
+    print(f"harp_tpu.telemetry: metrics exporter on "
+          f"http://{exporter.host}:{exporter.port} "
+          f"(/metrics, /snapshot{', /gang' if collector else ''})",
+          file=sys.stderr, flush=True)
+    return exporter
+
+
+def _enable_telemetry(sess, directory: str, interval: int, *,
+                      slo_p99_ms: float = 0.0, slo_window_s: float = 30.0,
+                      slo_error_budget: float = 0.1,
+                      metrics_port: int = -1) -> None:
     """Bring up the telemetry layer for this run (harp_tpu.telemetry):
     per-step JSONL + comm gauges always; in gang mode also the straggler
     publisher and the xprof window controller as chunk-boundary hooks —
     count-based cadence, safe because every member runs the same SPMD host
-    loop (same argv, shared checkpoint state)."""
+    loop (same argv, shared checkpoint state). Optionally the pull
+    exporter (--metrics-port) and the SLO watchdog (--slo-p99-ms) ride the
+    same boundary-hook surface."""
     import jax
 
     from harp_tpu import telemetry
@@ -124,10 +176,26 @@ def _enable_telemetry(sess, directory: str, interval: int) -> None:
     log.add_boundary_hook(XprofController(
         sess, trigger_path=os.path.join(directory, "xprof_request.json"),
         default_dir=os.path.join(directory, "xprof")))
+    collector = None
     if jax.process_count() > 1:
         from harp_tpu.telemetry.gang import GangCollector
 
-        log.add_boundary_hook(GangCollector(sess, directory))
+        collector = GangCollector(sess, directory)
+        log.add_boundary_hook(collector)
+    if metrics_port >= 0:
+        _start_exporter(metrics_port, collector)
+    if slo_p99_ms > 0:
+        from harp_tpu.telemetry.watchdog import SLOWatchdog
+
+        # fed the inter-boundary wall at every chunk boundary; on burn the
+        # xprof trigger file arms EVERY rank's controller (installed above).
+        # min_samples=3, not the request-stream default of 20: boundaries
+        # are CHUNKY (a job may only have tens of them), and 3 is the same
+        # cold-rank floor the straggler detector trusts a p50 at
+        wd = SLOWatchdog(slo_p99_ms / 1e3, window_s=slo_window_s,
+                         error_budget=slo_error_budget, min_samples=3,
+                         telemetry_dir=directory, metrics=log.metrics)
+        log.add_boundary_hook(wd.boundary_hook())
 
 
 def _config_from_args(cls, ns, **overrides):

@@ -39,6 +39,16 @@ engine police it.
 Load generation lives in :mod:`harp_tpu.benchmark.serving_load`
 (``bench.py --only serving``): p50/p99 latency + QPS at >=3 traffic mixes,
 published through :mod:`harp_tpu.telemetry`.
+
+The serving observability plane (r13) rides this package without touching
+a traced program: sampled requests carry per-stage span stamps
+(:mod:`harp_tpu.telemetry.spans`), every worker can serve a Prometheus
+``/metrics`` + JSON ``/snapshot`` pull endpoint
+(``ServeWorker(metrics_port=...)`` / ``local_gang(metrics_port=...)``),
+the top-k endpoint histograms lookup skew per owning worker (the hot-key
+signal), and an optional per-worker SLO watchdog
+(``local_gang(slo_p99_s=...)``) turns sustained p99/error-budget burn
+into an xprof window + straggler snapshot + journaled incident.
 """
 
 from __future__ import annotations

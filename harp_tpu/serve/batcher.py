@@ -30,6 +30,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from harp_tpu.serve import protocol
+from harp_tpu.telemetry import spans
 
 DEFAULT_MAX_WAIT_S = 0.002       # coalescing window: ~the latency floor a
 #                                  2 ms SLA-budget router can afford to spend
@@ -55,6 +56,7 @@ class MicroBatcher:
         self.max_batch = min(max_batch or endpoint.max_batch,
                              endpoint.max_batch)
         self.metrics = metrics
+        self.queue_high_watermark = 0
         self._pending: collections.deque = collections.deque()
         self._cv = threading.Condition()
         self._stopping = False
@@ -74,8 +76,25 @@ class MicroBatcher:
         with self._cv:
             if self._stopping:
                 return False
+            spans.stamp(msg, spans.ENQUEUE)
             self._pending.append((msg, time.perf_counter()))
+            depth = len(self._pending)
             self._cv.notify()
+        # PRE-dispatch queue visibility (the post-dispatch occupancy gauge
+        # cannot see growth under overload: a queue building faster than
+        # dispatches drain it looks exactly like healthy coalescing there).
+        # The depth gauge is the instantaneous backlog; the high watermark
+        # only ever rises, so a past overload stays visible in a scrape.
+        self.metrics.gauge(f"serve.queue_depth.{self.endpoint.name}", depth)
+        if depth > self.queue_high_watermark:
+            self.queue_high_watermark = depth
+            self.metrics.gauge(
+                f"serve.queue_high_watermark.{self.endpoint.name}", depth)
+        if depth > self.max_batch:
+            # more waiting than one dispatch can take = overload by
+            # definition; count every such submit so the overload DURATION
+            # is visible, not just its peak
+            self.metrics.count(f"serve.queue_overfull.{self.endpoint.name}")
         return True
 
     # ------------------------------------------------------------------ #
@@ -128,7 +147,21 @@ class MicroBatcher:
             dl = m.get("deadline_ts")
             (expired if dl is not None and now > dl else live).append(m)
         for m in expired:
-            self._safe_reply(m, ok=False, error=protocol.ERR_DEADLINE)
+            # the error carries the request's measured AGE and the deadline
+            # it missed: a client sees whether its deadline was tighter
+            # than the coalescing window + queue it actually waited in, so
+            # it can tune deadline vs max_wait_s from the reply alone
+            age_ms = (now - m["ts"]) * 1e3 if isinstance(
+                m.get("ts"), (int, float)) else None
+            over_ms = (now - m["deadline_ts"]) * 1e3
+            self._safe_reply(
+                m, ok=False,
+                error=f"{protocol.ERR_DEADLINE}: request age "
+                      f"{age_ms:.1f} ms missed deadline by {over_ms:.1f} ms"
+                      f" (batcher max_wait_s={self.max_wait_s})"
+                if age_ms is not None else
+                f"{protocol.ERR_DEADLINE}: missed deadline by "
+                f"{over_ms:.1f} ms (batcher max_wait_s={self.max_wait_s})")
             self.metrics.count(f"serve.deadline_expired.{self.endpoint.name}")
         # per-request admission BEFORE coalescing: one mismatched op or
         # malformed payload costs that one request a clean error — its
@@ -147,6 +180,11 @@ class MicroBatcher:
         if not live:
             return
         t0 = time.perf_counter()
+        for m in live:
+            # host-side, BEFORE the resident compiled fn: the span's
+            # dispatch stage brackets the jitted call from outside (the
+            # zero-drift contract — nothing here enters the traced program)
+            spans.stamp(m, spans.DISPATCH_START)
         try:
             batch = np.asarray([m["data"] for m in live])
             results = self.endpoint.dispatch(batch)
@@ -160,6 +198,8 @@ class MicroBatcher:
             self.metrics.count(f"serve.dispatch_errors.{self.endpoint.name}")
             return
         wall = time.perf_counter() - t0
+        for m in live:
+            spans.stamp(m, spans.DISPATCH_END)
         n = len(live)
         bucket = self.endpoint.bucket_for(n)
         self.metrics.observe(f"serve.dispatch.{self.endpoint.name}", wall)

@@ -327,7 +327,11 @@ class TopKEndpoint(Endpoint):
     def __init__(self, session: HarpSession, name: str, user_factors,
                  item_factors, k: int = 10,
                  user_ids: Optional[np.ndarray] = None,
-                 bucket_sizes: Optional[Sequence[int]] = None):
+                 bucket_sizes: Optional[Sequence[int]] = None,
+                 metrics=None):
+        if metrics is None:
+            from harp_tpu.utils.metrics import DEFAULT as metrics
+        self.metrics = metrics
         super().__init__(session, name, bucket_sizes)
         uf = np.asarray(user_factors, np.float32)
         items = np.asarray(item_factors, np.float32)
@@ -347,6 +351,12 @@ class TopKEndpoint(Endpoint):
         self._ids = ids.astype(np.int64)         # host index arrays only —
         self._owner = (ids % w).astype(np.int64)  # the shard map, not data
         self._owner_routed = False
+        self._owner_map_host: Optional[np.ndarray] = None
+        # per-owner lookup-skew histogram (host-side, pre-dispatch): the
+        # measurement the ROADMAP hot-key item is built against — owner =
+        # id mod W melts under Zipfian traffic, and this is where that
+        # shows first
+        self._lookup_owner_counts = np.zeros(w, np.int64)
         self._dim = uf.shape[1]
         slot, counts, cap = self._kv_layout(self._owner)
         self._slot, self._counts, self._cap = slot, counts, cap
@@ -409,7 +419,9 @@ class TopKEndpoint(Endpoint):
         # restore instead of racing a half-written shard or pairing the
         # old program with the new state
         with self._resident_lock:
-            keys_d, vals_d, counts_d, items = self._state[:4]
+            # only the factor payload and item table feed the move; keys/
+            # counts are rebuilt host-side below (_keys_counts)
+            vals_d, items = self._state[1], self._state[3]
             plan = rs.plan_moves(
                 mine, self._owner[mine] * self._cap + self._slot[mine],
                 len(uf), w * self._cap, w, self._dim * 4)
@@ -468,7 +480,7 @@ class TopKEndpoint(Endpoint):
         # in-flight dispatches finish on the old pair, later ones see the
         # owner-routed pair — never a mix
         with self._resident_lock:
-            keys_d, vals_d, counts_d, items = self._state[:4]
+            vals_d, items = self._state[1], self._state[3]
             # every row may shift slots, so the whole store reshards —
             # source is the LIVE device array (flat order owner*cap + slot)
             plan = rs.plan_moves(
@@ -481,6 +493,8 @@ class TopKEndpoint(Endpoint):
             owner_map = (np.arange(span, dtype=np.int64) % w).astype(
                 np.int32)
             owner_map[self._ids] = owner
+            self._owner_map_host = owner_map    # the skew histogram follows
+            #                                     the moved shards too
             keys, counts_dev = self._keys_counts(owner, slot, counts, cap)
             self._state = (keys, new_vals, counts_dev, items,
                            sess.replicate_put(owner_map))
@@ -557,10 +571,60 @@ class TopKEndpoint(Endpoint):
             out_specs=(sess.shard(),) * 3,
             donate_argnums=(4,))
 
+    def _note_lookup(self, ids: np.ndarray) -> None:
+        """Accumulate the per-owner lookup histogram for one request-id
+        batch — HOST numpy off the ids the batcher already holds, strictly
+        PRE-dispatch (nothing here touches a device array or the traced
+        program; the jaxlint budget gate stays byte-identical)."""
+        if not len(ids):
+            return
+        w = self.session.num_workers
+        if self._owner_map_host is not None:
+            # post-rebalance: known ids follow the moved shard map, ids
+            # outside the span fall back to the modulo (exactly what the
+            # routed dispatch does)
+            span = len(self._owner_map_host)
+            owners = np.where((ids >= 0) & (ids < span),
+                              self._owner_map_host[
+                                  np.clip(ids, 0, span - 1)],
+                              ids % w)
+        else:
+            owners = ids % w
+        counts = np.bincount(owners.astype(np.int64), minlength=w)
+        self._lookup_owner_counts += counts
+        total = int(self._lookup_owner_counts.sum())
+        hottest = int(self._lookup_owner_counts.argmax())
+        for r in range(w):
+            if counts[r]:
+                self.metrics.count(
+                    f"serve.lookup_owner.{self.name}.r{r}", int(counts[r]))
+        # skew = hottest owner's share / the uniform share (1.0 = balanced,
+        # W = everything on one worker)
+        self.metrics.gauge(
+            f"serve.lookup_skew.{self.name}",
+            float(self._lookup_owner_counts[hottest]) * w / total)
+
+    def reset_lookup_skew(self) -> None:
+        """Zero the cumulative histogram (the load generator calls this
+        after warmup so the all-zero warmup ids don't read as a hot key)."""
+        self._lookup_owner_counts[:] = 0
+
+    def lookup_skew(self) -> dict:
+        """The cumulative per-owner lookup histogram: counts per rank, the
+        hottest rank, and its skew vs a uniform spread (hot-key signal)."""
+        counts = self._lookup_owner_counts
+        total = int(counts.sum())
+        hottest = int(counts.argmax())
+        return {"counts": [int(c) for c in counts], "total": total,
+                "hottest": hottest,
+                "skew": (float(counts[hottest]) * len(counts) / total
+                         if total else 0.0)}
+
     def _place_query(self, batch, bucket: int):
         ids = np.asarray(batch, np.int64)
         if len(ids) and (ids.min() < 0 or ids.max() >= keyval.EMPTY):
             raise ValueError(f"query ids must be in [0, {keyval.EMPTY})")
+        self._note_lookup(ids)
         qb = np.full((bucket,), keyval.EMPTY, np.int32)
         qb[: len(ids)] = ids.astype(np.int32)
         return self.session.scatter(jnp.asarray(qb, jnp.int32))

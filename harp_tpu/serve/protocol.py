@@ -23,6 +23,14 @@ Reply::
 ``batch``/``bucket`` expose the micro-batcher's coalescing (how many
 requests rode this dispatch, into which static bucket) — the load generator
 derives its occupancy stats from them without touching the server.
+
+A SAMPLED request additionally carries a ``"trace"`` dict
+(:mod:`harp_tpu.telemetry.spans`): per-stage wall-clock stamps appended at
+every host boundary the frame crosses, returned on the reply so the client
+reconstructs the full span. Unsampled frames (the default) carry no trace
+key. A deadline-exceeded reply's ``error`` string carries the request's
+measured age and the miss margin, so a client can tune ``deadline_ts``
+against the coalescing window from the error alone.
 """
 
 from __future__ import annotations

@@ -28,12 +28,14 @@ from __future__ import annotations
 import atexit
 import itertools
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from harp_tpu.parallel.events import EventQueue
 from harp_tpu.parallel.p2p import P2PTransport
 from harp_tpu.serve import protocol
 from harp_tpu.serve.batcher import DEFAULT_MAX_WAIT_S, MicroBatcher
+from harp_tpu.telemetry import spans
 
 _LIVE: "set" = set()          # live workers + clients, closed at exit
 _live_lock = threading.Lock()
@@ -79,7 +81,8 @@ class ServeWorker:
                  placement: Dict[str, int], *,
                  peers: Optional[Dict[int, Tuple[str, int]]] = None,
                  secret: Optional[bytes] = None, host: str = "127.0.0.1",
-                 max_wait_s: float = DEFAULT_MAX_WAIT_S, metrics=None):
+                 max_wait_s: float = DEFAULT_MAX_WAIT_S, metrics=None,
+                 slo=None, metrics_port: Optional[int] = None):
         if metrics is None:
             from harp_tpu.utils.metrics import DEFAULT as metrics
         self.session = session
@@ -90,6 +93,17 @@ class ServeWorker:
         # worker must never overwrite the forwarding route to that worker
         self._worker_ranks = set(self.placement.values()) | {rank}
         self.metrics = metrics
+        # the serving-plane observability hooks (both optional): an
+        # SLOWatchdog fed one (age, ok) sample per reply, and a per-worker
+        # pull exporter (metrics_port=0 binds an ephemeral port — read it
+        # back from worker.exporter.port)
+        self.slo = slo
+        self.exporter = None
+        if metrics_port is not None:
+            from harp_tpu.telemetry.exporter import MetricsExporter
+
+            self.exporter = MetricsExporter(metrics, port=metrics_port,
+                                            rank=rank)
         self.queue = EventQueue()
         self.transport = P2PTransport(self.queue, rank=rank,
                                       peers=peers if peers is not None
@@ -139,6 +153,7 @@ class ServeWorker:
 
     def _handle(self, msg: dict) -> None:
         self.metrics.count("serve.requests")
+        spans.stamp(msg, spans.RECV)
         if self._draining:
             self._reply(msg, ok=False, error=protocol.ERR_SHUTTING_DOWN)
             return
@@ -148,6 +163,7 @@ class ServeWorker:
             # fan out to the owning worker; reply_to stays the client's, so
             # the answer travels owner -> client directly
             try:
+                spans.stamp(msg, spans.FORWARD)
                 self.transport.send(owner, msg)
                 self.metrics.count("serve.forwarded")
             except (KeyError, ConnectionError) as e:
@@ -174,6 +190,14 @@ class ServeWorker:
 
     def _reply(self, msg: dict, ok: bool, result=None, error=None,
                batch=None, bucket=None) -> None:
+        if self.slo is not None:
+            # one (age, ok) sample per reply: age = now − the client's
+            # submit wall, i.e. end-to-end minus the reply hop — the
+            # server-side view of the SLO, available for EVERY request
+            # (sampled or not), errors included (they burn the budget)
+            ts = msg.get("ts")
+            if isinstance(ts, (int, float)):
+                self.slo.observe(time.time() - ts, ok=ok)
         try:
             rank, rhost, rport = msg["reply_to"]
             rank, rport = int(rank), int(rport)
@@ -190,10 +214,17 @@ class ServeWorker:
             self.metrics.count("serve.reply_rank_collisions")
             return
         self.transport.add_peer(rank, (rhost, rport))
+        reply = protocol.make_reply(
+            msg, ok=ok, result=result, error=error,
+            served_by=self.rank, batch=batch, bucket=bucket)
+        tr = msg.get(spans.TRACE_KEY)
+        if tr is not None:
+            # the accumulated trace rides the reply home: the CLIENT holds
+            # the complete span (including this reply hop) and records it
+            spans.stamp_trace(tr, spans.REPLY_SEND)
+            reply[spans.TRACE_KEY] = tr
         try:
-            self.transport.send(rank, protocol.make_reply(
-                msg, ok=ok, result=result, error=error,
-                served_by=self.rank, batch=batch, bucket=bucket))
+            self.transport.send(rank, reply)
         except (OSError, TypeError):
             # client gone (closed/crashed between send and reply — OSError
             # covers ConnectionError and gaierror) or a reply_to host of a
@@ -232,6 +263,8 @@ class ServeWorker:
             self._stop.set()
             self._thread.join(timeout)
             self.transport.close()
+            if self.exporter is not None:
+                self.exporter.close()
             _unregister_live(self)
         if drain_errors:
             raise TimeoutError("; ".join(drain_errors))
@@ -283,12 +316,22 @@ class RouterClient:
     def __init__(self, rank: int, peers: Dict[int, Tuple[str, int]],
                  placement: Dict[str, int], *,
                  secret: Optional[bytes] = None, host: str = "127.0.0.1",
-                 metrics=None):
+                 metrics=None, trace_sample: Optional[int] = None,
+                 span_metrics=None):
         if metrics is None:
             from harp_tpu.utils.metrics import DEFAULT as metrics
         self.rank = rank
         self.placement = dict(placement)
         self.metrics = metrics
+        # request tracing (telemetry.spans): sample every Nth submit; None
+        # reads HARP_TRACE_REQUESTS (0/unset = off). span_metrics is where
+        # the per-stage timers land — defaults to this client's registry,
+        # overridable so concurrent load threads never share a reservoir
+        # (TimerReservoir.add is an unsynchronized read-modify-write)
+        self.trace_sample = (spans.env_sample_interval()
+                             if trace_sample is None else int(trace_sample))
+        self.span_metrics = span_metrics if span_metrics is not None \
+            else metrics
         self._default_dest = min(peers) if peers else 0
         self.queue = EventQueue()
         self.transport = P2PTransport(self.queue, rank=rank,
@@ -314,10 +357,29 @@ class RouterClient:
             if not (isinstance(payload, dict)
                     and payload.get("kind") == protocol.REPLY):
                 continue
+            tr = payload.get(spans.TRACE_KEY)
+            if tr is not None:
+                spans.stamp_trace(tr, spans.REPLY_RECV)
             with self._lock:
                 pending = self._waiting.pop(payload.get("id"), None)
             if pending is not None:
                 pending._set(payload)
+            if tr is not None:
+                self._finish_span(tr)
+
+    def _finish_span(self, tr: dict) -> None:
+        """Reconstruct + record one returned span. The receive thread is
+        the client's lifeline: a malformed trace (a stamp tuple mangled in
+        transit) costs that one span, counted, never the loop."""
+        try:
+            bd = spans.breakdown(tr)
+            if bd is None:
+                self.metrics.count("serve.spans_incomplete")
+                return
+            spans.observe_span(bd, self.span_metrics)
+            spans.record_span(bd)
+        except (KeyError, TypeError, ValueError, IndexError):
+            self.metrics.count("serve.spans_malformed")
 
     def submit(self, op: str, model: str, data, *,
                deadline_ts: Optional[float] = None,
@@ -327,13 +389,16 @@ class RouterClient:
         forwarding leg this way)."""
         if self._closed:
             raise ConnectionError("client is closed")
-        rid = f"{self.rank}-{next(self._ids)}"
+        n = next(self._ids)
+        rid = f"{self.rank}-{n}"
         if dest is None:
             dest = self.placement.get(model, self._default_dest)
         msg = protocol.make_request(
             rid, op, model, data,
             reply_to=(self.rank,) + tuple(self.transport.address),
             deadline_ts=deadline_ts)
+        if self.trace_sample and n % self.trace_sample == 0:
+            spans.start_trace(msg, op=op, model=model)
 
         def discard(rid=rid):
             with self._lock:
@@ -373,8 +438,12 @@ class RouterClient:
 
 def local_gang(session, worker_endpoints: List[Dict[str, object]], *,
                secret: Optional[bytes] = b"harp-serve-local",
-               max_wait_s: float = DEFAULT_MAX_WAIT_S, metrics=None
-               ) -> Tuple[List[ServeWorker], Callable[[], RouterClient]]:
+               max_wait_s: float = DEFAULT_MAX_WAIT_S, metrics=None,
+               slo_p99_s: Optional[float] = None,
+               slo_kw: Optional[dict] = None,
+               metrics_port: Optional[int] = None,
+               trace_sample: Optional[int] = None
+               ) -> Tuple[List[ServeWorker], Callable[..., RouterClient]]:
     """An in-process serving gang on loopback (the tier-1/bench topology;
     multi-host gangs pass explicit peer maps or KV rendezvous instead).
 
@@ -382,12 +451,29 @@ def local_gang(session, worker_endpoints: List[Dict[str, object]], *,
     placement is derived from it. Returns the workers plus a factory that
     mints connected clients on fresh ranks. All transports authenticate
     with ``secret`` and bind loopback only.
+
+    Observability plane (all optional): ``slo_p99_s`` installs one
+    :class:`~harp_tpu.telemetry.watchdog.SLOWatchdog` per worker at that
+    p99 target (``slo_kw`` forwards window/budget/telemetry_dir);
+    ``metrics_port`` starts a per-worker pull exporter (0 = ephemeral
+    ports, >0 = ``port + rank`` so same-host workers never collide);
+    ``trace_sample`` makes every minted client trace every Nth request
+    (None = the HARP_TRACE_REQUESTS default).
     """
+    from harp_tpu.telemetry.watchdog import SLOWatchdog
+
     placement = {name: r for r, eps in enumerate(worker_endpoints)
                  for name in eps}
     workers = [ServeWorker(session, r, eps, placement, peers={},
                            secret=secret, max_wait_s=max_wait_s,
-                           metrics=metrics)
+                           metrics=metrics,
+                           slo=(SLOWatchdog(slo_p99_s, rank=r,
+                                            metrics=metrics,
+                                            **(slo_kw or {}))
+                                if slo_p99_s else None),
+                           metrics_port=(None if metrics_port is None
+                                         else (metrics_port + r
+                                               if metrics_port else 0)))
                for r, eps in enumerate(worker_endpoints)]
     for w in workers:
         for v in workers:
@@ -395,9 +481,14 @@ def local_gang(session, worker_endpoints: List[Dict[str, object]], *,
                 w.transport.add_peer(v.rank, v.address)
     next_rank = itertools.count(len(workers))
 
-    def make_client() -> RouterClient:
+    def make_client(metrics_override=None,
+                    span_metrics=None) -> RouterClient:
         return RouterClient(next(next_rank),
                             {w.rank: w.address for w in workers},
-                            placement, secret=secret, metrics=metrics)
+                            placement, secret=secret,
+                            metrics=(metrics_override if metrics_override
+                                     is not None else metrics),
+                            trace_sample=trace_sample,
+                            span_metrics=span_metrics)
 
     return workers, make_client

@@ -30,6 +30,22 @@ Layers:
   boundaries into a per-rank directory: profile a slow gang without
   restarting it.
 
+The serving observability plane (PR 12) extends the same contracts to the
+request path:
+
+* :mod:`~harp_tpu.telemetry.spans` — end-to-end request tracing: sampled
+  request frames carry per-stage host-boundary stamps through the serve
+  router/batcher; completed spans land as ``kind: "span"`` events in the
+  same JSONL stream. Zero-drift gated like the rest of the package.
+* :mod:`~harp_tpu.telemetry.exporter` — a per-worker stdlib-HTTP pull
+  exporter: ``/metrics`` (Prometheus text), ``/snapshot`` (JSON), and the
+  gang-aggregated ``/gang`` view off the events-control-plane exchange.
+* :mod:`~harp_tpu.telemetry.watchdog` — an SLO watchdog over the span /
+  step stream (rolling p99 target + error budget) that, on sustained
+  burn, auto-arms an xprof window, dumps the straggler-format snapshot,
+  and journals the incident — the PR 7 machinery triggered by its own
+  signal instead of an operator.
+
 Enable with ``harp_tpu.run ... --telemetry-dir DIR [--telemetry-interval N]``
 or programmatically via :func:`configure`; the ``HARP_TELEMETRY_DIR`` /
 ``HARP_TELEMETRY_INTERVAL`` environment variables do the same for embedded
@@ -38,17 +54,25 @@ callers (gang members inherit them from the launcher environment).
 
 from __future__ import annotations
 
+from harp_tpu.telemetry import spans
 from harp_tpu.telemetry.comm_ledger import (CommLedger, ledger_for,
                                             load_manifest, manifest_target)
+from harp_tpu.telemetry.exporter import (MetricsExporter,
+                                         aggregate_snapshots,
+                                         prometheus_text)
 from harp_tpu.telemetry.gang import (gather_snapshots, publish_straggler_report,
                                      straggler_report)
+from harp_tpu.telemetry.spans import record_span
 from harp_tpu.telemetry.step_log import (StepLog, active, configure, disable,
                                          phase, record_chunk, record_timing)
+from harp_tpu.telemetry.watchdog import SLOWatchdog
 from harp_tpu.telemetry.xprof import XprofController, request_xprof
 
 __all__ = [
-    "CommLedger", "StepLog", "XprofController", "active", "configure",
+    "CommLedger", "MetricsExporter", "SLOWatchdog", "StepLog",
+    "XprofController", "active", "aggregate_snapshots", "configure",
     "disable", "gather_snapshots", "ledger_for", "load_manifest",
-    "manifest_target", "phase", "publish_straggler_report", "record_chunk",
-    "record_timing", "request_xprof", "straggler_report",
+    "manifest_target", "phase", "prometheus_text",
+    "publish_straggler_report", "record_chunk", "record_span",
+    "record_timing", "request_xprof", "spans", "straggler_report",
 ]

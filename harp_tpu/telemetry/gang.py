@@ -145,28 +145,39 @@ def straggler_report(per_rank: Dict[int, dict], *,
             "suspects": suspects, "bsp_suspects": bsp_suspects}
 
 
+def write_straggler_report(directory: str, report: dict) -> str:
+    """Persist one report as ``<dir>/straggler_report.json`` (atomic
+    rename — the supervisor may read it mid-publish)."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, REPORT_NAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
 def publish_straggler_report(session, directory: str, *, metrics=None,
                              k: float = DEFAULT_K,
                              min_samples: int = DEFAULT_MIN_SAMPLES,
-                             min_gap_s: float = DEFAULT_MIN_GAP_S) -> dict:
-    """Gather + detect + persist. COLLECTIVE (all ranks call); every rank
-    returns the same report, rank 0 writes ``<dir>/straggler_report.json``
-    (atomic rename — the supervisor may read it mid-publish)."""
+                             min_gap_s: float = DEFAULT_MIN_GAP_S,
+                             snapshots: Optional[Dict[int, dict]] = None
+                             ) -> dict:
+    """Gather + detect + persist. COLLECTIVE (all ranks call) unless
+    ``snapshots`` passes an already-gathered exchange (the GangCollector
+    does — it keeps the map for the exporter's ``/gang`` view); every rank
+    returns the same report, rank 0 writes ``<dir>/straggler_report.json``."""
     import jax
 
-    snaps = gather_snapshots(session, metrics=metrics)
+    snaps = (gather_snapshots(session, metrics=metrics)
+             if snapshots is None else snapshots)
     report = straggler_report(snaps, k=k, min_samples=min_samples,
                               min_gap_s=min_gap_s)
     if metrics is None:
         from harp_tpu.utils.metrics import DEFAULT as metrics
     metrics.gauge("telemetry.straggler_suspects", len(report["suspects"]))
     if jax.process_index() == 0:
-        os.makedirs(directory, exist_ok=True)
-        path = os.path.join(directory, REPORT_NAME)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(report, f, indent=1, sort_keys=True)
-        os.replace(tmp, path)
+        write_straggler_report(directory, report)
     return report
 
 
@@ -200,6 +211,10 @@ class GangCollector:
         self.min_samples = min_samples
         self.min_gap_s = min_gap_s
         self.last_report: Optional[dict] = None
+        # the most recent gathered {rank: snapshot} exchange — the metrics
+        # exporter's /gang view reads it (telemetry.exporter), so a scrape
+        # sees the same data the straggler detector judged
+        self.last_snapshots: Optional[Dict[int, dict]] = None
 
     def __call__(self, boundary_index: int, log) -> None:
         if boundary_index % (self.every * log.interval) != 0:
@@ -207,7 +222,9 @@ class GangCollector:
         from harp_tpu.telemetry.step_log import phase
 
         with phase("gang.straggler_publish"):
+            snaps = gather_snapshots(self.session, metrics=log.metrics)
+            self.last_snapshots = snaps
             self.last_report = publish_straggler_report(
                 self.session, self.directory, metrics=log.metrics,
                 k=self.k, min_samples=self.min_samples,
-                min_gap_s=self.min_gap_s)
+                min_gap_s=self.min_gap_s, snapshots=snaps)

@@ -1,0 +1,209 @@
+"""End-to-end request tracing for the serving plane — per-stage spans.
+
+PR 10's serving path was a black box between client submit and reply: the
+load generator measured end-to-end latency, the batcher gauged its own
+dispatch wall, and nothing connected the two. This module makes every
+sampled request carry a **trace**: a tiny dict riding the request frame
+(the p2p transport pickles plain dicts — same reasoning as the protocol
+frames) that every HOST boundary the request already crosses stamps with a
+``(stage, wall-clock)`` pair:
+
+========================  ====================================================
+stage                     stamped by
+========================  ====================================================
+``submit``                ``RouterClient.submit`` (request leaves the client)
+``recv``                  ``ServeWorker._handle`` (every worker that receives
+                          the frame — twice when forwarded)
+``forward``               the non-owning worker, before the forward send
+``enqueue``               ``MicroBatcher.submit`` (accepted for coalescing)
+``dispatch_start``        the batcher, immediately before the endpoint
+                          dispatch (the resident compiled fn)
+``dispatch_end``          the batcher, immediately after
+``reply_send``            ``ServeWorker._reply`` (reply leaves the owner)
+``reply_recv``            ``RouterClient._recv_loop`` (reply arrives)
+========================  ====================================================
+
+The reply carries the accumulated trace back, so the CLIENT holds the full
+span and reconstructs the breakdown (:func:`breakdown`): the six stage
+durations PARTITION the end-to-end latency exactly —
+
+    total = submit_hop + route + coalesce + dispatch + reply_build
+            + reply_hop
+
+(``route`` covers receive→enqueue including the forward hop when the
+request landed on a non-owning worker; ``forward_hop`` is additionally
+reported on its own). Completed spans are observed into per-stage bounded
+timers (``serve.span.<stage>``) and sampled into the PR 7 JSONL stream as
+``kind: "span"`` events (:func:`record_span`) — same file, same versioned
+schema, same bounded ring as the training step events.
+
+**Zero-drift contract (the PR 7 contract extended to serving).** Every
+stamp above sits in host router/batcher Python, around — never inside —
+the resident jitted dispatch. The collective-budget manifest is
+byte-identical with request tracing enabled; ``tools/ci_checks.sh`` stage 2
+runs the jaxpr engine with BOTH ``HARP_TELEMETRY_DIR`` and
+``HARP_TRACE_REQUESTS`` set and tier-1 keeps the serve-target version of
+the check, so the contract is gated, not promised.
+
+Sampling: a client samples every Nth request (``trace_sample=N`` on
+:class:`~harp_tpu.serve.router.RouterClient`, or the
+``HARP_TRACE_REQUESTS`` environment variable; ``1`` traces everything,
+``0``/unset disables). An unsampled request carries no trace key and pays
+one dict lookup per boundary.
+
+Clocks: stamps are ``time.time()`` so a multi-host gang produces
+comparable timelines; within one host the stage deltas are exact, across
+hosts the two hop stages absorb any clock skew (documented — the fleet
+item's NTP-bounded skew note rides there, not here).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+TRACE_KEY = "trace"
+ENV_SAMPLE = "HARP_TRACE_REQUESTS"
+
+# stage names (the stamp vocabulary — breakdown() depends on these)
+SUBMIT = "submit"
+RECV = "recv"
+FORWARD = "forward"
+ENQUEUE = "enqueue"
+DISPATCH_START = "dispatch_start"
+DISPATCH_END = "dispatch_end"
+REPLY_SEND = "reply_send"
+REPLY_RECV = "reply_recv"
+
+# the stages whose durations partition the end-to-end latency, in order
+STAGES = ("submit_hop", "route", "coalesce", "dispatch", "reply_build",
+          "reply_hop")
+
+SPAN_VERSION = 1
+
+
+def env_sample_interval() -> int:
+    """The process-default sampling interval (0 = tracing off)."""
+    try:
+        return max(0, int(os.environ.get(ENV_SAMPLE, "0") or 0))
+    except ValueError:
+        return 0
+
+
+def start_trace(msg: Dict, *, op: str, model: str) -> Dict:
+    """Attach a fresh trace to an outgoing request frame and stamp
+    ``submit``. The trace id IS the request id (already unique per client),
+    so reply matching and span matching share one identity."""
+    tr = {"id": msg["id"], "op": op, "model": model, "stamps": []}
+    msg[TRACE_KEY] = tr
+    stamp(msg, SUBMIT)
+    return tr
+
+
+def stamp(msg: Dict, stage: str) -> None:
+    """Stamp one host boundary on a request/reply frame; a frame without a
+    trace (unsampled — the common case) costs exactly this dict lookup."""
+    tr = msg.get(TRACE_KEY)
+    if tr is not None:
+        tr["stamps"].append((stage, time.time()))
+
+
+def stamp_trace(tr: Dict, stage: str) -> None:
+    """Stamp a bare trace dict (the reply path holds the trace after the
+    request frame is gone)."""
+    tr["stamps"].append((stage, time.time()))
+
+
+def _first(stamps: List, stage: str) -> Optional[float]:
+    for s, ts in stamps:
+        if s == stage:
+            return ts
+    return None
+
+
+def _last(stamps: List, stage: str) -> Optional[float]:
+    out = None
+    for s, ts in stamps:
+        if s == stage:
+            out = ts
+    return out
+
+
+def breakdown(tr: Dict) -> Optional[Dict]:
+    """Reconstruct the per-stage durations of a completed span.
+
+    Returns ``None`` when the span is incomplete (a request rejected
+    before the batcher — draining, unknown model, validation — never
+    reaches the dispatch stamps; callers count those, they don't chart
+    them). The six stage durations sum to ``total_s`` exactly: they are
+    consecutive differences over one ordered stamp sequence.
+    """
+    stamps = tr.get("stamps", ())
+    submit = _first(stamps, SUBMIT)
+    recv_last = _last(stamps, RECV)
+    enqueue = _first(stamps, ENQUEUE)
+    d0 = _first(stamps, DISPATCH_START)
+    d1 = _first(stamps, DISPATCH_END)
+    rs = _first(stamps, REPLY_SEND)
+    rr = _first(stamps, REPLY_RECV)
+    if None in (submit, recv_last, enqueue, d0, d1, rs, rr):
+        return None
+    recv_first = _first(stamps, RECV)
+    fwd = _first(stamps, FORWARD)
+    out = {
+        "trace_id": tr.get("id"),
+        "op": tr.get("op"),
+        "model": tr.get("model"),
+        "forwarded": fwd is not None,
+        "total_s": rr - submit,
+        "submit_hop_s": recv_first - submit,
+        "route_s": enqueue - recv_first,
+        "coalesce_s": d0 - enqueue,
+        "dispatch_s": d1 - d0,
+        "reply_build_s": rs - d1,
+        "reply_hop_s": rr - rs,
+    }
+    if fwd is not None:
+        out["forward_hop_s"] = recv_last - fwd
+    return out
+
+
+def observe_span(bd: Dict, metrics) -> None:
+    """Feed one breakdown into the bounded per-stage timers — the surface
+    the serving bench's stage table and the SLO watchdog read. Names:
+    ``serve.span.total`` plus ``serve.span.<stage>`` per partition stage."""
+    metrics.observe("serve.span.total", bd["total_s"])
+    for stage in STAGES:
+        metrics.observe(f"serve.span.{stage}", bd[f"{stage}_s"])
+    metrics.count("serve.spans")
+    if bd["forwarded"]:
+        metrics.count("serve.spans_forwarded")
+
+
+def record_span(bd: Dict, *, extra: Optional[Dict] = None) -> None:
+    """Emit one completed span into the PR 7 JSONL stream as a
+    ``kind: "span"`` event (same versioned schema family, same bounded
+    ring, ``DIR/rank<r>/steps.jsonl``). No-op when telemetry is off.
+
+    Unlike ``record_chunk``/``record_timing`` this does NOT tick a StepLog
+    boundary: boundaries run gang-collective hooks on a count cadence, and
+    a serving client shares no cadence with a training loop — spans flush
+    on the log's interval of recorded spans instead (plus the existing
+    ring-capacity and atexit flushes).
+    """
+    from harp_tpu.telemetry import step_log
+
+    log = step_log.active()
+    if log is None:
+        return
+    ev = {"v": SPAN_VERSION, "kind": "span", "rank": log.rank,
+          "ts": round(time.time(), 3)}
+    for k, v in bd.items():
+        ev[k] = round(v, 9) if isinstance(v, float) else v
+    if extra:
+        ev.update(extra)
+    log.emit(ev)
+    log.metrics.count("telemetry.spans")
+    if log.metrics.counters["telemetry.spans"] % log.interval == 0:
+        log.flush()

@@ -30,6 +30,7 @@ import collections
 import contextlib
 import json
 import os
+import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
@@ -68,6 +69,13 @@ class StepLog:
         self.dropped = 0
         self.boundaries = 0
         self._ring: collections.deque = collections.deque(maxlen=capacity)
+        # flush drains the ring with a check-then-popleft loop and appends
+        # to the JSONL file: single-writer in the training loops, but the
+        # serving plane's span recorder (telemetry.spans.record_span) runs
+        # on every RouterClient receive thread — the lock makes the drain
+        # and the file append atomic (emit stays lock-free: deque.append
+        # is atomic under the GIL)
+        self._flush_lock = threading.Lock()
         self._hooks: List[Callable[[int, "StepLog"], None]] = []
         self._rank_dir = os.path.join(directory, f"rank{self.rank}")
         os.makedirs(self._rank_dir, exist_ok=True)
@@ -83,13 +91,17 @@ class StepLog:
         self._ring.append(event)
 
     def flush(self) -> int:
-        """Drain the ring to the per-rank JSONL file; returns events written."""
-        if not self._ring:
-            return 0
-        n = len(self._ring)
-        with open(self.path, "a") as f:
-            while self._ring:
-                f.write(json.dumps(self._ring.popleft()) + "\n")
+        """Drain the ring to the per-rank JSONL file; returns events
+        written. Thread-safe (span recorders flush from client receive
+        threads)."""
+        with self._flush_lock:
+            if not self._ring:
+                return 0
+            n = 0
+            with open(self.path, "a") as f:
+                while self._ring:
+                    f.write(json.dumps(self._ring.popleft()) + "\n")
+                    n += 1
         self.metrics.count("telemetry.events_flushed", n)
         return n
 

@@ -1,0 +1,266 @@
+"""SLO watchdog — a rolling-window evaluator that acts on its own signal.
+
+PR 7 built the diagnostics (straggler reports, on-demand xprof windows)
+but left the trigger to an operator: somebody had to notice a latency
+regression and drop the trigger file. This module closes that loop. A
+:class:`SLOWatchdog` consumes a latency/error stream — serving request
+ages (``ServeWorker`` feeds it at every reply) or training chunk-boundary
+walls (the :meth:`boundary_hook` adapter) — over a rolling window and,
+when the SLO burns SUSTAINED (rolling p99 above target, or the error
+fraction past the budget, for ``sustain`` consecutive evaluations), it
+fires the existing PR 7 machinery exactly once per burn window:
+
+* **xprof window** — writes the operator trigger file
+  (``<dir>/xprof_request.json``) next to the telemetry output. Every rank
+  polling that directory (the :class:`~harp_tpu.telemetry.xprof.
+  XprofController` boundary hook) opens a profiler window at its next
+  boundary — the alignment-safe gang-wide arm path PR 7 built for exactly
+  this kind of out-of-band trigger.
+* **straggler snapshot** — dumps the LOCAL ``Metrics.snapshot()`` as
+  ``slo_snapshot_rank<r>_<n>.json`` and attaches the latest PUBLISHED
+  straggler report (the GangCollector's cadence output) to the incident.
+  Deliberately non-collective: a watchdog fires when ITS rank sees burn,
+  and a collective gather from an unaligned boundary would deadlock the
+  gang — the same reasoning that keeps xprof window start/stop local.
+* **incident journal** — appends one JSON line to
+  ``<dir>/slo_incidents.jsonl`` (the supervisor-journal idiom): observed
+  p99 vs target, error fraction vs budget, window occupancy, and what was
+  triggered. ``slo.incidents`` counts, ``slo.burning`` gauges the live
+  state.
+
+"Exactly once per burn window": the watchdog is a two-state machine
+(ok ⇄ burning). Entering *burning* fires; staying in it does not; an
+evaluation that sees the SLO met returns to *ok* and re-arms. A sustained
+fault (the ``slow@`` grammar) therefore produces ONE incident, not one
+per reply — and a second burn after recovery produces a second.
+
+Evaluation is amortized: ``observe`` is deque appends; the window is only
+evaluated every ``eval_interval_s`` (or when a hook forces it at a chunk
+boundary), so the reply path pays no percentile sort per request.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Deque, Optional, Tuple
+
+DEFAULT_WINDOW_S = 30.0
+DEFAULT_BUDGET = 0.1          # tolerated error fraction over the window
+DEFAULT_SUSTAIN = 2           # consecutive burning evaluations before firing
+DEFAULT_MIN_SAMPLES = 20
+INCIDENTS_NAME = "slo_incidents.jsonl"
+TRIGGER_NAME = "xprof_request.json"     # xprof.XprofController's file path
+
+
+class SLOWatchdog:
+    """Rolling p99-target + error-budget evaluator (module docstring).
+
+    ``p99_target_s`` is the SLO; ``telemetry_dir`` is where the trigger
+    file, snapshots, and incident journal land (None = evaluate and
+    count, trigger nothing — tests and dry runs). ``xprof_steps`` sizes
+    the profiler window the incident arms.
+    """
+
+    def __init__(self, p99_target_s: float, *,
+                 window_s: float = DEFAULT_WINDOW_S,
+                 error_budget: float = DEFAULT_BUDGET,
+                 sustain: int = DEFAULT_SUSTAIN,
+                 min_samples: int = DEFAULT_MIN_SAMPLES,
+                 eval_interval_s: Optional[float] = None,
+                 telemetry_dir: Optional[str] = None,
+                 xprof_steps: int = 8, rank: Optional[int] = None,
+                 metrics=None, on_burn=None):
+        if metrics is None:
+            from harp_tpu.utils.metrics import DEFAULT as metrics
+        if p99_target_s <= 0:
+            raise ValueError(f"p99_target_s must be positive, got "
+                             f"{p99_target_s}")
+        self.p99_target_s = float(p99_target_s)
+        self.window_s = float(window_s)
+        self.error_budget = float(error_budget)
+        self.sustain = max(1, int(sustain))
+        self.min_samples = max(1, int(min_samples))
+        self.eval_interval_s = (window_s / 4.0 if eval_interval_s is None
+                                else float(eval_interval_s))
+        self.telemetry_dir = telemetry_dir
+        self.xprof_steps = int(xprof_steps)
+        self.rank = (int(os.environ.get("HARP_PROCESS_ID", "0"))
+                     if rank is None else rank)
+        self.metrics = metrics
+        self.on_burn = on_burn
+        self.incidents = 0
+        self.burning = False
+        self._burn_streak = 0
+        self._last_eval = 0.0
+        # (ts, latency_s, ok) — pruned to window_s on every evaluation.
+        # The lock covers every window/state access: a ServeWorker feeds
+        # observe() from its receive thread AND every MicroBatcher thread,
+        # and an unguarded evaluate() iterating the deque mid-append would
+        # raise (and _safe_reply would eat the reply it rode in on)
+        self._lock = threading.Lock()
+        self._window: Deque[Tuple[float, float, bool]] = collections.deque()
+
+    # -- stream input -------------------------------------------------------
+
+    def observe(self, latency_s: float, *, ok: bool = True,
+                now: Optional[float] = None) -> None:
+        """One request/step outcome. Cheap (deque append + a cadence check
+        under the lock); the window only gets sorted when an evaluation is
+        due. Thread-safe — any reply/boundary thread may call it."""
+        now = time.time() if now is None else now
+        with self._lock:
+            self._window.append((now, float(latency_s), bool(ok)))
+            self._evaluate_locked(now=now)
+
+    # -- evaluation ---------------------------------------------------------
+
+    def _prune(self, now: float) -> None:
+        cutoff = now - self.window_s
+        w = self._window
+        while w and w[0][0] < cutoff:
+            w.popleft()
+
+    def window_stats(self, now: Optional[float] = None) -> dict:
+        """Rolling p99 + error fraction over the live window (nearest-rank
+        over the actual samples — the window is already bounded by time,
+        no reservoir needed). Thread-safe."""
+        with self._lock:
+            return self._window_stats_locked(
+                time.time() if now is None else now)
+
+    def _window_stats_locked(self, now: float) -> dict:
+        self._prune(now)
+        lats = sorted(v for _t, v, _ok in self._window)
+        n = len(lats)
+        errors = sum(1 for _t, _v, ok in self._window if not ok)
+        p99 = lats[min(n - 1, max(0, -(-99 * n // 100) - 1))] if n else None
+        return {"samples": n, "p99_s": p99,
+                "error_fraction": (errors / n) if n else 0.0}
+
+    def evaluate(self, now: Optional[float] = None,
+                 force: bool = False) -> Optional[dict]:
+        """Run one evaluation if the cadence is due (or ``force``).
+        Returns the incident record when this evaluation FIRED, else
+        None. Thread-safe."""
+        with self._lock:
+            return self._evaluate_locked(now=now, force=force)
+
+    def _evaluate_locked(self, now: Optional[float] = None,
+                         force: bool = False) -> Optional[dict]:
+        now = time.time() if now is None else now
+        if not force and now - self._last_eval < self.eval_interval_s:
+            return None
+        self._last_eval = now
+        stats = self._window_stats_locked(now)
+        burn = (stats["samples"] >= self.min_samples
+                and (stats["p99_s"] > self.p99_target_s
+                     or stats["error_fraction"] > self.error_budget))
+        if not burn:
+            self._burn_streak = 0
+            if self.burning:
+                self.burning = False
+                self.metrics.gauge("slo.burning", 0.0)
+            return None
+        self._burn_streak += 1
+        if self.burning or self._burn_streak < self.sustain:
+            return None
+        self.burning = True          # entering the burn window: fire ONCE
+        self.metrics.gauge("slo.burning", 1.0)
+        return self._fire(now, stats)
+
+    # -- actions ------------------------------------------------------------
+
+    def _fire(self, now: float, stats: dict) -> dict:
+        self.incidents += 1
+        self.metrics.count("slo.incidents")
+        incident = {
+            "v": 1, "kind": "slo-burn", "ts": round(now, 3),
+            "rank": self.rank, "incident": self.incidents,
+            "p99_s": stats["p99_s"], "p99_target_s": self.p99_target_s,
+            "error_fraction": round(stats["error_fraction"], 4),
+            "error_budget": self.error_budget,
+            "window_s": self.window_s, "samples": stats["samples"],
+            "triggered": [],
+        }
+        if self.telemetry_dir:
+            incident["triggered"] = self._trigger_pr7_machinery(incident)
+            self._journal(incident)
+        if self.on_burn is not None:
+            self.on_burn(incident)
+        return incident
+
+    def _trigger_pr7_machinery(self, incident: dict) -> list:
+        from harp_tpu.telemetry.gang import read_straggler_report
+
+        triggered = []
+        d = self.telemetry_dir
+        os.makedirs(d, exist_ok=True)
+        trigger = os.path.join(d, TRIGGER_NAME)
+        try:
+            # atomic write: every rank's XprofController polls this file by
+            # (mtime, size) token — a torn write must not half-arm the gang
+            tmp = trigger + f".tmp-{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"steps": self.xprof_steps,
+                           "reason": f"slo-burn #{self.incidents} "
+                                     f"rank {self.rank}"}, f)
+            os.replace(tmp, trigger)
+            triggered.append("xprof_request")
+        except OSError as e:
+            incident["xprof_error"] = str(e)
+        snap_path = os.path.join(
+            d, f"slo_snapshot_rank{self.rank}_{self.incidents}.json")
+        try:
+            self.metrics.dump(snap_path)
+            triggered.append("metrics_snapshot")
+            incident["snapshot"] = os.path.basename(snap_path)
+        except OSError as e:
+            incident["snapshot_error"] = str(e)
+        report = read_straggler_report(d)
+        if report is not None:
+            incident["straggler_report"] = {
+                "ts": report.get("ts"),
+                "suspects": report.get("suspects"),
+                "bsp_suspects": report.get("bsp_suspects")}
+            triggered.append("straggler_report_attached")
+        return triggered
+
+    def _journal(self, incident: dict) -> None:
+        path = os.path.join(self.telemetry_dir, INCIDENTS_NAME)
+        try:
+            with open(path, "a") as f:
+                f.write(json.dumps(incident) + "\n")
+        except OSError as e:
+            incident["journal_error"] = str(e)
+
+    # -- training-gang adapter ----------------------------------------------
+
+    def boundary_hook(self):
+        """A StepLog boundary hook feeding the watchdog the INTER-BOUNDARY
+        wall — the time between consecutive chunk boundaries. That is the
+        honest training-side SLO signal: it covers the compiled chunk, the
+        checkpoint save, AND any host-side drag the chunk-internal step
+        timer cannot see (the ``slow@`` fault grammar injects its sleep at
+        the iteration boundary, OUTSIDE the timed chunk — a per-step-timer
+        feed would be blind to exactly the fault class this watchdog
+        exists to catch). The p99 target is therefore per chunk boundary
+        when the watchdog rides a training gang, and per request when it
+        rides the serving reply path."""
+        watchdog = self
+        prev = [None]
+
+        def hook(_boundary_index: int, log) -> None:
+            now_pc = time.perf_counter()
+            if prev[0] is not None:
+                with watchdog._lock:
+                    watchdog._window.append(
+                        (time.time(), now_pc - prev[0], True))
+                    watchdog._evaluate_locked(force=True)
+            prev[0] = now_pc
+
+        hook.close = lambda: None
+        return hook

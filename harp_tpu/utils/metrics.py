@@ -62,6 +62,21 @@ class TimerReservoir:
             if j < self._cap:
                 self.samples[j] = value
 
+    def merge(self, other: "TimerReservoir") -> None:
+        """Fold another reservoir in: count/total stay EXACT (plain sums),
+        the sample buffer concatenates and uniformly subsamples back to
+        the cap. The single-writer contract stands — merging is for
+        per-thread reservoirs joined AFTER their writers stop (the
+        serving load generator's pattern), not for concurrent use."""
+        self.count += other.count
+        self.total += other.total
+        if other.count:
+            self.last = other.last
+        combined = self.samples + list(other.samples)
+        if len(combined) > self._cap:
+            combined = self._rng.sample(combined, self._cap)
+        self.samples = combined
+
     def percentile(self, q: float) -> float:
         """Nearest-rank percentile over the reservoir (q in [0, 1])."""
         return self.percentiles([q])[0]
@@ -110,6 +125,16 @@ class Metrics:
             yield
         finally:
             self.observe(name, time.perf_counter() - t0)
+
+    def merge(self, other: "Metrics") -> None:
+        """Fold another registry in (counters summed, gauges taken from
+        ``other``, timers reservoir-merged) — the serial join step for
+        per-thread registries."""
+        for name, v in other.counters.items():
+            self.counters[name] += v
+        self.gauges.update(other.gauges)
+        for name, r in other.timers.items():
+            self.timers[name].merge(r)
 
     def timing(self, name: str) -> Dict[str, float]:
         r = self.timers.get(name)

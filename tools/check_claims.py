@@ -194,6 +194,27 @@ CLAIMS: List[Claim] = [
     Claim("serving_perf_mixed_qps", "PERF.md",
           r"\| mixed \(0\.5\) \| \S+ ms \| \S+ ms \| (\S+) \|",
           ("serving", "mixes", "mixed", "qps")),
+    # PERF.md r13 (ISSUE 12): the serving-plane observability rows — the
+    # per-stage latency breakdown from sampled request spans and its
+    # reconciliation against the measured end-to-end (the stage durations
+    # partition each span, so the mean ratio is ~1.0 by construction and
+    # the p50 ratio sits inside a stated 25% band; both are pinned here so
+    # the prose can never quote a breakdown the record doesn't back).
+    Claim("serving_stage_coalesce_p50", "PERF.md",
+          r"\| coalesce wait \| (\S+) ms",
+          ("serving", "stage_breakdown", "coalesce", "p50_ms")),
+    Claim("serving_stage_dispatch_p50", "PERF.md",
+          r"\| dispatch \(resident compiled fn\) \| (\S+) ms",
+          ("serving", "stage_breakdown", "dispatch", "p50_ms")),
+    Claim("serving_stage_reply_hop_p50", "PERF.md",
+          r"\| reply hop \| (\S+) ms",
+          ("serving", "stage_breakdown", "reply_hop", "p50_ms")),
+    Claim("serving_span_mean_ratio", "PERF.md",
+          r"stage-mean sum / span mean = (\S+)",
+          ("serving", "reconciliation", "mean_ratio"), rel_tol=0.02),
+    Claim("serving_span_p50_ratio", "PERF.md",
+          r"stage-p50 sum / span p50 = (\S+)",
+          ("serving", "reconciliation", "p50_ratio")),
     Claim("comm_serve_classify", "PERF.md",
           r"Serve classify dispatch \(serve_classify_nn\) \| (\S+) B",
           ("targets", "serve_classify_nn", "bytes_per_step"),

@@ -41,7 +41,15 @@
 #                     step programs must reproduce the pinned manifest
 #                     exactly — telemetry is host-boundary-only by design,
 #                     and this gate makes that a checked contract, not a
-#                     comment (ISSUE 7).
+#                     comment (ISSUE 7). r13: the same invocation also sets
+#                     HARP_TRACE_REQUESTS=1, extending the zero-drift gate
+#                     to the serving observability plane — request tracing
+#                     stamps host boundaries in the serve router/batcher,
+#                     so the serve_* dispatch targets (and everything else)
+#                     must stay byte-identical with per-request spans on.
+#                     The exporter /metrics//snapshot//gang schema smoke
+#                     and the watchdog/skew/span tests ride stage 4
+#                     (tests/test_serve_observability.py).
 #   3. check_claims — README/PERF headline numbers vs BENCH_local.json.
 #   4. tier-1       — the ROADMAP.md verify suite (which itself re-runs
 #                     jaxlint's clean-repo + budget checks as tests, so
@@ -57,9 +65,10 @@ rc=0
 echo "== [1/4] jaxlint =="
 python -m tools.jaxlint || rc=1
 
-echo "== [2/4] jaxlint budget with telemetry ON (zero drift) =="
+echo "== [2/4] jaxlint budget with telemetry + request tracing ON (zero drift) =="
 tele_dir="$(mktemp -d /tmp/_tele_gate.XXXXXX)"
-HARP_TELEMETRY_DIR="$tele_dir" python -m tools.jaxlint --jaxpr-only || rc=1
+HARP_TELEMETRY_DIR="$tele_dir" HARP_TRACE_REQUESTS=1 \
+    python -m tools.jaxlint --jaxpr-only || rc=1
 
 echo "== [3/4] check_claims =="
 python tools/check_claims.py || rc=1
