@@ -14,8 +14,8 @@ Protocol per mix:
 * the op per request follows a per-client seeded RNG at the mix's top-k
   fraction, ids/feature vectors drawn from the served id/feature space;
 * latency = submit -> reply, observed into a PER-THREAD bounded
-  :class:`~harp_tpu.utils.metrics.TimerReservoir` (reservoir adds are
-  unsynchronized read-modify-writes, so threads never share one) and
+  :class:`~harp_tpu.utils.metrics.TimerReservoir` (contention isolation:
+  the hot loop never touches a shared registry lock) and
   merged serially after the join; the row's p50/p99 come from
   ``Metrics.timing()`` — the same percentile surface the straggler
   reports use (one latency format, ISSUE 10 satellite);
@@ -163,8 +163,9 @@ def measure(session=None, *, requests_per_mix: int = 900,
         session, max_wait_s=max_wait_s, metrics=metrics, seed=seed,
         trace_sample=trace_sample)
     # span timers are observed by each client's RECEIVE thread — one
-    # registry per client (TimerReservoir.add is unsynchronized), merged
-    # serially after the mixes, same rule as the load threads below
+    # registry per client so one client's spans never dilute another's,
+    # merged serially after the mixes (reservoir adds are lock-guarded
+    # since jaxlint v3, so this is isolation, not a race workaround)
     span_regs = [Metrics() for _ in range(num_clients)]
     clients = [make_client(span_metrics=span_regs[i])
                for i in range(num_clients)]
@@ -207,11 +208,9 @@ def measure(session=None, *, requests_per_mix: int = 900,
             per_client = max(1, requests_per_mix // num_clients)
             errors: list = []
             barrier = threading.Barrier(num_clients + 1)
-            # one registry PER CLIENT THREAD: TimerReservoir.add is a
-            # read-modify-write with no lock, so concurrent observes into
-            # one shared reservoir can lose samples and undercount the
-            # row's request count — threads record privately and the
-            # reservoirs merge serially after the join
+            # one registry PER CLIENT THREAD: recording privately keeps
+            # the hot loop off the shared registry lock (zero contention
+            # in the measured path) and the serial post-join merge exact
             thread_regs = [Metrics() for _ in clients]
             threads = [threading.Thread(
                 target=_client_loop,

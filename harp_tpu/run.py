@@ -142,8 +142,7 @@ def _start_exporter(metrics_port: int, collector):
     port = metrics_port + rank if metrics_port > 0 else 0
     exporter = MetricsExporter(
         port=port, rank=rank,
-        gang=(lambda: collector.last_snapshots) if collector is not None
-        else None)
+        gang=collector.snapshots if collector is not None else None)
     print(f"harp_tpu.telemetry: metrics exporter on "
           f"http://{exporter.host}:{exporter.port} "
           f"(/metrics, /snapshot{', /gang' if collector else ''})",

@@ -113,7 +113,13 @@ class ServeWorker:
             name: MicroBatcher(ep, self._make_reply_fn(), metrics=metrics,
                                max_wait_s=max_wait_s)
             for name, ep in self.endpoints.items()}
-        self._draining = False
+        # drain flag crosses threads (begin_drain on the caller's thread,
+        # checked in the receive loop): an Event, not a bare bool — the
+        # JL301 class the concurrency lint exists for. close() races
+        # itself too (module-level atexit sweep vs an owner thread's
+        # close), so its idempotence check-then-act runs under a lock
+        self._draining = threading.Event()
+        self._close_lock = threading.Lock()
         self._closed = False
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -154,7 +160,7 @@ class ServeWorker:
     def _handle(self, msg: dict) -> None:
         self.metrics.count("serve.requests")
         spans.stamp(msg, spans.RECV)
-        if self._draining:
+        if self._draining.is_set():
             self._reply(msg, ok=False, error=protocol.ERR_SHUTTING_DOWN)
             return
         model = msg.get("model")
@@ -237,7 +243,7 @@ class ServeWorker:
     def begin_drain(self) -> None:
         """Stop ACCEPTING: from now on new requests get a clean
         "shutting-down" reply while already-accepted batches finish."""
-        self._draining = True
+        self._draining.set()
 
     def close(self, timeout: float = 30.0) -> None:
         """Drain in-flight micro-batches, stop threads, close the
@@ -245,9 +251,10 @@ class ServeWorker:
         releases the receive thread, socket, and live-set registration
         before the TimeoutError propagates — close never leaves the worker
         half-open and unretryable."""
-        if self._closed:
-            return
-        self._closed = True
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
         self.begin_drain()
         drain_errors = []
         try:
@@ -326,8 +333,9 @@ class RouterClient:
         # request tracing (telemetry.spans): sample every Nth submit; None
         # reads HARP_TRACE_REQUESTS (0/unset = off). span_metrics is where
         # the per-stage timers land — defaults to this client's registry,
-        # overridable so concurrent load threads never share a reservoir
-        # (TimerReservoir.add is an unsynchronized read-modify-write)
+        # overridable so load generators can keep per-client registries
+        # (reservoirs are lock-guarded; the override is isolation, not a
+        # race workaround)
         self.trace_sample = (spans.env_sample_interval()
                              if trace_sample is None else int(trace_sample))
         self.span_metrics = span_metrics if span_metrics is not None \
@@ -345,6 +353,9 @@ class RouterClient:
             target=self._recv_loop, daemon=True,
             name=f"harp-serve-client-{rank}")
         self._thread.start()
+        # same atexit-sweep-vs-owner close race as ServeWorker: the
+        # idempotence check-then-act must be atomic
+        self._close_lock = threading.Lock()
         self._closed = False
         _register_live(self)
 
@@ -421,9 +432,10 @@ class RouterClient:
         return self.submit(op, model, data, dest=dest).result(timeout)
 
     def close(self) -> None:
-        if self._closed:
-            return
-        self._closed = True
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
         self._stop.set()
         self._thread.join(5.0)
         self.transport.close()

@@ -26,9 +26,9 @@ environment) bound to loopback by default, serving
   merge exactly, so the roll-up reports the honest worst case and keeps
   the per-rank rows for anything finer).
 
-The exporter reads registry state that concurrent workers mutate without
-locks; a scrape sees a torn-but-valid point-in-time view (same semantics
-as ``snapshot()`` everywhere else). It binds port 0 (ephemeral) unless
+``Metrics.snapshot()`` is registry-lock-consistent (jaxlint v3 made the
+registry thread-safe), so a scrape sees one coherent point-in-time view
+even while workers mutate. The exporter binds port 0 (ephemeral) unless
 told otherwise, serves from a daemon thread, and registers an atexit close
 so an abandoned gang never leaks the listening socket.
 """
@@ -141,11 +141,11 @@ class MetricsExporter:
                     body, ctype = exporter._render(self.path)
                 except (KeyError, TypeError, ValueError,
                         RuntimeError) as e:
-                    # a half-written registry entry costs one scrape a 500,
-                    # never the serving thread — RuntimeError is the
-                    # realistic one: snapshot() iterating the timers dict
-                    # while a serving thread inserts a first-seen name
-                    # raises "dictionary changed size during iteration"
+                    # a malformed registry entry costs one scrape a 500,
+                    # never the serving thread (snapshot() itself is
+                    # registry-lock-consistent since jaxlint v3; this is
+                    # defense against custom gang= sources and schema
+                    # surprises)
                     self.send_error(500, str(e))
                     return
                 if body is None:
@@ -166,6 +166,11 @@ class MetricsExporter:
             target=self._server.serve_forever, daemon=True,
             name=f"harp-metrics-exporter-{self.port}")
         self._thread.start()
+        # close() races itself: atexit fires on the main thread while an
+        # owner (ServeWorker.close, a test teardown) may be closing from
+        # another — the lock makes the idempotence check-then-act atomic
+        # so shutdown() runs exactly once (JL302's check-then-act class)
+        self._close_lock = threading.Lock()
         self._closed = False
         atexit.register(self.close)
 
@@ -194,9 +199,10 @@ class MetricsExporter:
         return None, ""
 
     def close(self) -> None:
-        if self._closed:
-            return
-        self._closed = True
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
         self._server.shutdown()
         self._server.server_close()
         self._thread.join(5.0)

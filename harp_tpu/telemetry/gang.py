@@ -23,6 +23,7 @@ from __future__ import annotations
 import json
 import os
 import statistics
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -210,11 +211,39 @@ class GangCollector:
         self.k = k
         self.min_samples = min_samples
         self.min_gap_s = min_gap_s
-        self.last_report: Optional[dict] = None
-        # the most recent gathered {rank: snapshot} exchange — the metrics
-        # exporter's /gang view reads it (telemetry.exporter), so a scrape
-        # sees the same data the straggler detector judged
-        self.last_snapshots: Optional[Dict[int, dict]] = None
+        # the most recent gathered {rank: snapshot} exchange and report —
+        # WRITTEN on the training thread at boundary cadence, READ by the
+        # metrics exporter's /gang scrape threads (telemetry.exporter wires
+        # ``gang=collector.snapshots``). The lock makes each publish
+        # atomic (the mid-publish torn read PR 12's hand review missed —
+        # JL301); a consumer that needs the (snapshots, report) pair from
+        # ONE exchange must read through ``last_exchange()`` — two
+        # separate property reads can still straddle a publish.
+        self._publish_lock = threading.Lock()
+        self._last_report: Optional[dict] = None
+        self._last_snapshots: Optional[Dict[int, dict]] = None
+
+    @property
+    def last_report(self) -> Optional[dict]:
+        with self._publish_lock:
+            return self._last_report
+
+    @property
+    def last_snapshots(self) -> Optional[Dict[int, dict]]:
+        with self._publish_lock:
+            return self._last_snapshots
+
+    def last_exchange(self):
+        """``(snapshots, report)`` from ONE publish, read under one lock
+        hold — the pair-consistent accessor (separate property reads can
+        interleave with a boundary publish)."""
+        with self._publish_lock:
+            return self._last_snapshots, self._last_report
+
+    def snapshots(self) -> Optional[Dict[int, dict]]:
+        """The exporter's ``gang=`` source (bound method, scrape-thread
+        safe)."""
+        return self.last_snapshots
 
     def __call__(self, boundary_index: int, log) -> None:
         if boundary_index % (self.every * log.interval) != 0:
@@ -223,8 +252,10 @@ class GangCollector:
 
         with phase("gang.straggler_publish"):
             snaps = gather_snapshots(self.session, metrics=log.metrics)
-            self.last_snapshots = snaps
-            self.last_report = publish_straggler_report(
+            report = publish_straggler_report(
                 self.session, self.directory, metrics=log.metrics,
                 k=self.k, min_samples=self.min_samples,
                 min_gap_s=self.min_gap_s, snapshots=snaps)
+            with self._publish_lock:
+                self._last_snapshots = snaps
+                self._last_report = report

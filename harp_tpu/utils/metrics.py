@@ -19,6 +19,7 @@ import logging
 import math
 import os
 import random
+import threading
 import time
 from collections import defaultdict
 from typing import Dict, Optional
@@ -39,43 +40,54 @@ class TimerReservoir:
     the whole stream after the cap is reached. The RNG is seeded per
     reservoir: snapshots are reproducible for a deterministic observation
     stream.
+
+    Thread-safe: ``add``/``merge``/``percentiles`` serialize on ``lock``
+    (``count += 1`` and the eviction slot write are read-modify-writes —
+    concurrent unsynchronized adders lose observations, jaxlint JL302).
+    Pass an existing lock to share one lock across a registry (``Metrics``
+    does); standalone reservoirs get their own.
     """
 
-    __slots__ = ("count", "total", "last", "samples", "_cap", "_rng")
+    __slots__ = ("count", "total", "last", "samples", "_cap", "_rng",
+                 "_lock")
 
-    def __init__(self, cap: int = RESERVOIR_CAP, seed: int = 0):
+    def __init__(self, cap: int = RESERVOIR_CAP, seed: int = 0,
+                 lock: Optional[threading.RLock] = None):
         self.count = 0
         self.total = 0.0
         self.last = 0.0
         self.samples = []
         self._cap = cap
         self._rng = random.Random(seed)
+        self._lock = lock if lock is not None else threading.RLock()
 
     def add(self, value: float) -> None:
-        self.count += 1
-        self.total += value
-        self.last = value
-        if len(self.samples) < self._cap:
-            self.samples.append(value)
-        else:
-            j = self._rng.randrange(self.count)
-            if j < self._cap:
-                self.samples[j] = value
+        with self._lock:
+            self.count += 1
+            self.total += value
+            self.last = value
+            if len(self.samples) < self._cap:
+                self.samples.append(value)
+            else:
+                j = self._rng.randrange(self.count)
+                if j < self._cap:
+                    self.samples[j] = value
 
     def merge(self, other: "TimerReservoir") -> None:
         """Fold another reservoir in: count/total stay EXACT (plain sums),
         the sample buffer concatenates and uniformly subsamples back to
-        the cap. The single-writer contract stands — merging is for
-        per-thread reservoirs joined AFTER their writers stop (the
-        serving load generator's pattern), not for concurrent use."""
-        self.count += other.count
-        self.total += other.total
-        if other.count:
-            self.last = other.last
-        combined = self.samples + list(other.samples)
-        if len(combined) > self._cap:
-            combined = self._rng.sample(combined, self._cap)
-        self.samples = combined
+        the cap. ``other`` should be quiescent (the serial join step for
+        per-thread/per-mix reservoirs after their writers stop); this
+        reservoir may keep serving concurrent adds."""
+        with self._lock:
+            self.count += other.count
+            self.total += other.total
+            if other.count:
+                self.last = other.last
+            combined = self.samples + list(other.samples)
+            if len(combined) > self._cap:
+                combined = self._rng.sample(combined, self._cap)
+            self.samples = combined
 
     def percentile(self, q: float) -> float:
         """Nearest-rank percentile over the reservoir (q in [0, 1])."""
@@ -85,33 +97,58 @@ class TimerReservoir:
         """Several nearest-rank percentiles off ONE sort of the reservoir
         (timing() asks for three; snapshot() calls timing() per timer at
         every gang publish — re-sorting 2048 samples per quantile would
-        triple that cost for nothing)."""
-        if not self.samples:
-            return [float("nan")] * len(qs)
-        ordered = sorted(self.samples)
-        n = len(ordered)
-        return [ordered[min(n - 1, max(0, math.ceil(q * n) - 1))]
-                for q in qs]
+        triple that cost for nothing). The lock covers only the sample
+        COPY; the sort runs outside it so a hot adder never blocks on a
+        reader's O(n log n)."""
+        with self._lock:
+            samples = list(self.samples)
+        return _nearest_rank(samples, qs)
+
+
+def _nearest_rank(samples: list, qs) -> list:
+    """Nearest-rank percentiles over an (unsorted) sample copy — pure, no
+    lock: callers copy under their lock and compute out here."""
+    if not samples:
+        return [float("nan")] * len(qs)
+    ordered = sorted(samples)
+    n = len(ordered)
+    return [ordered[min(n - 1, max(0, math.ceil(q * n) - 1))]
+            for q in qs]
 
 
 class Metrics:
-    """Process-local metric registry (counters, gauges, timers)."""
+    """Process-local metric registry (counters, gauges, timers).
+
+    Thread-safe under ONE registry lock: the serving plane feeds a shared
+    registry from the router receive thread, every micro-batcher thread,
+    and the exporter's scrape threads at once — ``counters[name] += v``
+    is a read-modify-write that silently loses increments unsynchronized
+    (jaxlint JL302), and an unlocked ``snapshot()`` iterating the timers
+    dict mid-insert raises. The per-timer reservoirs share the same
+    (reentrant) lock, so one acquisition covers a whole
+    ``observe``/``timing`` and lock order is trivially consistent.
+    """
 
     def __init__(self):
+        self._lock = threading.RLock()
         self.counters: Dict[str, float] = defaultdict(float)
         self.gauges: Dict[str, float] = {}
-        self.timers: Dict[str, TimerReservoir] = defaultdict(TimerReservoir)
+        self.timers: Dict[str, TimerReservoir] = defaultdict(
+            lambda: TimerReservoir(lock=self._lock))
 
     def count(self, name: str, value: float = 1.0) -> None:
-        self.counters[name] += value
+        with self._lock:
+            self.counters[name] += value
 
     def gauge(self, name: str, value: float) -> None:
-        self.gauges[name] = value
+        with self._lock:
+            self.gauges[name] = value
 
     def observe(self, name: str, seconds: float) -> None:
         """Record one timer sample directly (for durations measured by the
         caller — e.g. the telemetry layer's amortized per-step times)."""
-        self.timers[name].add(seconds)
+        with self._lock:
+            self.timers[name].add(seconds)
 
     @contextlib.contextmanager
     def timer(self, name: str):
@@ -129,27 +166,48 @@ class Metrics:
     def merge(self, other: "Metrics") -> None:
         """Fold another registry in (counters summed, gauges taken from
         ``other``, timers reservoir-merged) — the serial join step for
-        per-thread registries."""
-        for name, v in other.counters.items():
-            self.counters[name] += v
-        self.gauges.update(other.gauges)
-        for name, r in other.timers.items():
-            self.timers[name].merge(r)
+        per-thread registries (``other`` quiescent; this registry may stay
+        live)."""
+        with self._lock:
+            for name, v in other.counters.items():
+                self.counters[name] += v
+            self.gauges.update(other.gauges)
+            for name, r in other.timers.items():
+                self.timers[name].merge(r)
+
+    @staticmethod
+    def _timing_from_state(count, total, last, samples) -> Dict[str, float]:
+        if not count:
+            return {}
+        p50, p90, p99 = _nearest_rank(samples, [0.50, 0.90, 0.99])
+        return {"count": count, "total_s": total, "mean_s": total / count,
+                "last_s": last, "p50_s": p50, "p90_s": p90, "p99_s": p99}
 
     def timing(self, name: str) -> Dict[str, float]:
-        r = self.timers.get(name)
-        if r is None or not r.count:
-            return {}
-        p50, p90, p99 = r.percentiles([0.50, 0.90, 0.99])
-        return {"count": r.count, "total_s": r.total,
-                "mean_s": r.total / r.count, "last_s": r.last,
-                "p50_s": p50, "p90_s": p90, "p99_s": p99}
+        with self._lock:
+            r = self.timers.get(name)
+            if r is None or not r.count:
+                return {}
+            state = (r.count, r.total, r.last, list(r.samples))
+        return self._timing_from_state(*state)
 
     def snapshot(self) -> Dict[str, object]:
+        """A consistent point-in-time view: ONE lock hold copies raw state
+        (a scrape never sees the timers dict mid-insert or a counter
+        between the load and the store of its increment), and the
+        per-timer percentile sorts run OUTSIDE the lock — an exporter
+        scrape must never stall the serving hot path for O(n log n) per
+        reservoir."""
+        with self._lock:
+            counters = dict(self.counters)
+            gauges = dict(self.gauges)
+            states = {k: (r.count, r.total, r.last, list(r.samples))
+                      for k, r in self.timers.items()}
         return {
-            "counters": dict(self.counters),
-            "gauges": dict(self.gauges),
-            "timers": {k: self.timing(k) for k in self.timers},
+            "counters": counters,
+            "gauges": gauges,
+            "timers": {k: self._timing_from_state(*s)
+                       for k, s in states.items()},
         }
 
     def dump(self, path: str) -> None:
@@ -161,14 +219,18 @@ class Metrics:
             json.dump(self.snapshot(), f, indent=2, sort_keys=True)
 
     def log_summary(self) -> None:
-        for name in sorted(self.timers):
-            s = self.timing(name)
+        # one consistent copy, then log OUTSIDE the lock (log.info does
+        # I/O — holding the registry lock across it would stall every
+        # serving thread for the duration of a handler flush)
+        snap = self.snapshot()
+        for name in sorted(snap["timers"]):
+            s = snap["timers"][name]
             if not s:
                 continue
             log.info("timer %-24s n=%d total=%.3fs mean=%.4fs p50=%.4fs "
                      "p99=%.4fs", name, s["count"], s["total_s"], s["mean_s"],
                      s["p50_s"], s["p99_s"])
-        for name, v in sorted(self.counters.items()):
+        for name, v in sorted(snap["counters"].items()):
             log.info("counter %-22s %.0f", name, v)
 
 

@@ -347,3 +347,475 @@ def test_dtype_policy_reports_bf16_accumulation():
     checkers_jaxpr._walk(jax.make_jaxpr(good)(x, x).jaxpr, counts, dtype_bad,
                          {})
     assert dtype_bad == []
+
+
+# -- JL3xx concurrency engine (ISSUE 13 tentpole) ---------------------------
+
+from tools.jaxlint.checkers_threads import check_concurrency  # noqa: E402
+
+_HOST_REL = "harp_tpu/serve/fake.py"
+
+
+def _runc(src, rel=_HOST_REL):
+    return check_concurrency(ast.parse(src), rel, src)
+
+
+def test_jl301_doctored_unguarded_shared_write_fails_loudly():
+    # the acceptance fixture: a receive-loop thread writes state the main
+    # thread reads, no lock anywhere — the PR 10-12 hand-review bug class
+    src = (
+        "class Worker:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._thread = threading.Thread(target=self._loop,\n"
+        "                                        daemon=True)\n"
+        "    def _loop(self):\n"
+        "        self.state = 'running'\n"
+        "    def poke(self):\n"
+        "        return self.state\n")
+    got = _runc(src)
+    assert _codes(got) == ["JL301"]
+    assert got[0].func == "_loop" and "self.state" in got[0].message
+    assert "thread:_loop" in got[0].message
+
+
+def test_jl301_guarded_write_twin_is_clean():
+    src = (
+        "class Worker:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._thread = threading.Thread(target=self._loop,\n"
+        "                                        daemon=True)\n"
+        "    def _loop(self):\n"
+        "        with self._lock:\n"
+        "            self.state = 'running'\n"
+        "    def poke(self):\n"
+        "        with self._lock:\n"
+        "            return self.state\n")
+    assert _runc(src) == []
+    # an Event signal instead of a bare flag is also clean (sync
+    # primitives manage their own safety)
+    src2 = (
+        "class Worker:\n"
+        "    def __init__(self):\n"
+        "        self._draining = threading.Event()\n"
+        "        self._thread = threading.Thread(target=self._loop,\n"
+        "                                        daemon=True)\n"
+        "    def _loop(self):\n"
+        "        if self._draining.is_set():\n"
+        "            return\n"
+        "    def begin_drain(self):\n"
+        "        self._draining.set()\n")
+    assert _runc(src2) == []
+
+
+def test_jl301_only_fires_in_host_trees():
+    src = (
+        "class Worker:\n"
+        "    def __init__(self):\n"
+        "        self._thread = threading.Thread(target=self._loop,\n"
+        "                                        daemon=True)\n"
+        "    def _loop(self):\n"
+        "        self.state = 1\n"
+        "    def poke(self):\n"
+        "        return self.state\n")
+    assert _runc(src, "harp_tpu/models/fake.py") == []
+    assert _codes(_runc(src, "harp_tpu/telemetry/fake.py")) == ["JL301"]
+
+
+def test_jl302_unsynchronized_rmw_and_check_then_act_are_flagged():
+    src = (
+        "class Counter:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0\n"
+        "        self._d = {}\n"
+        "        self._thread = threading.Thread(target=self._loop,\n"
+        "                                        daemon=True)\n"
+        "    def _loop(self):\n"
+        "        self._n += 1\n"
+        "        if 'k' in self._d:\n"
+        "            self._d.pop('k')\n"
+        "    def read(self):\n"
+        "        return self._n, self._d.get('k')\n")
+    got = _runc(src)
+    assert _codes(got) == ["JL302", "JL302"]
+    assert "read-modify-write" in got[0].message
+    assert "check-then-act" in got[1].message
+
+
+def test_jl302_guarded_rmw_twin_is_clean():
+    src = (
+        "class Counter:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0\n"
+        "        self._d = {}\n"
+        "        self._thread = threading.Thread(target=self._loop,\n"
+        "                                        daemon=True)\n"
+        "    def _loop(self):\n"
+        "        with self._lock:\n"
+        "            self._n += 1\n"
+        "            if 'k' in self._d:\n"
+        "                self._d.pop('k')\n"
+        "    def read(self):\n"
+        "        with self._lock:\n"
+        "            return self._n, self._d.get('k')\n")
+    assert _runc(src) == []
+
+
+def test_jl303_doctored_lock_order_inversion_fails_loudly():
+    src = (
+        "class AB:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def one(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+        "    def two(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n")
+    got = _runc(src)
+    assert _codes(got) == ["JL303"]
+    assert "deadlock" in got[0].message.lower()
+    assert "_a" in got[0].message and "_b" in got[0].message
+
+
+def test_jl303_cross_method_inversion_via_call_under_lock():
+    # one() holds _a and CALLS a method that takes _b; two() nests b -> a
+    src = (
+        "class AB:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def one(self):\n"
+        "        with self._a:\n"
+        "            self.take_b()\n"
+        "    def take_b(self):\n"
+        "        with self._b:\n"
+        "            pass\n"
+        "    def two(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n")
+    assert _codes(_runc(src)) == ["JL303"]
+
+
+def test_jl303_consistent_order_twin_is_clean():
+    src = (
+        "class AB:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def one(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+        "    def two(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n")
+    assert _runc(src) == []
+
+
+def test_jl304_unjoined_non_daemon_thread_is_flagged():
+    src = (
+        "class Spawner:\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self._loop)\n"
+        "        self._t.start()\n"
+        "    def _loop(self):\n"
+        "        pass\n")
+    got = _runc(src)
+    assert _codes(got) == ["JL304"] and "self._t" in got[0].message
+    # module-level function variant
+    src2 = (
+        "def fire_and_forget(fn):\n"
+        "    t = threading.Thread(target=fn)\n"
+        "    t.start()\n")
+    assert _codes(_runc(src2)) == ["JL304"]
+
+
+def test_jl304_joined_or_daemon_twins_are_clean():
+    joined = (
+        "class Spawner:\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self._loop)\n"
+        "        self._t.start()\n"
+        "    def _loop(self):\n"
+        "        pass\n"
+        "    def close(self):\n"
+        "        self._t.join(5.0)\n")
+    assert _runc(joined) == []
+    daemon = (
+        "class Spawner:\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self._loop, daemon=True)\n"
+        "        self._t.start()\n"
+        "    def _loop(self):\n"
+        "        pass\n")
+    assert _runc(daemon) == []
+    # local thread joined in the same function
+    local = (
+        "def run_and_wait(fn):\n"
+        "    t = threading.Thread(target=fn)\n"
+        "    t.start()\n"
+        "    t.join()\n")
+    assert _runc(local) == []
+
+
+def test_jl3xx_callback_protocol_flags_hook_state():
+    # __call__ is the hook/callback protocol: registered by one thread,
+    # invoked by another — public attrs written there are the class's
+    # cross-thread read surface (the GangCollector/exporter race)
+    src = (
+        "class Hook:\n"
+        "    def __call__(self, i, log):\n"
+        "        self.last = i\n")
+    got = _runc(src, "harp_tpu/telemetry/fake.py")
+    assert _codes(got) == ["JL301"]
+    # a lock-guarded publish is the clean twin
+    src2 = (
+        "class Hook:\n"
+        "    def __init__(self):\n"
+        "        self._publish_lock = threading.Lock()\n"
+        "    def __call__(self, i, log):\n"
+        "        with self._publish_lock:\n"
+        "            self._last = i\n"
+        "    @property\n"
+        "    def last(self):\n"
+        "        with self._publish_lock:\n"
+        "            return self._last\n")
+    assert _runc(src2, "harp_tpu/telemetry/fake.py") == []
+
+
+def test_jl3xx_rides_the_allowlist_and_staleness_contract():
+    # suppression and the staleness guarantee extend to JL3xx unchanged
+    f = Finding("JL301", "unguarded-shared-write", _HOST_REL, 7, "_loop",
+                "msg")
+    ok = {(_HOST_REL, "_loop", "JL301"):
+          "sticky single-writer flag, GIL-atomic store, reader tolerates "
+          "one-interval staleness"}
+    active, stale = apply_allowlist([f], ok)
+    assert active == [] and stale == []
+    active, stale = apply_allowlist([], ok)
+    assert active == [] and len(stale) == 1 and "prune" in stale[0]
+
+
+def test_repo_host_plane_is_clean_under_concurrency_checker():
+    # the tentpole's acceptance: the checker runs clean on the repo, with
+    # every pre-existing real finding fixed or individually justified
+    raw = run_ast_checkers(REPO, [check_concurrency])
+    active, _stale = apply_allowlist(raw, ALLOWLIST)
+    assert active == [], "\n".join(str(f) for f in active)
+    # ... and the justified exemptions are LIVE findings, not blanket
+    # passes: the raw run still sees the allowlisted sites
+    raw_keys = {f.key for f in raw}
+    for key in [k for k in ALLOWLIST if k[2].startswith("JL30")]:
+        assert key in raw_keys, f"stale JL3xx allowlist entry {key}"
+
+
+# -- gang-mode collective budgets (ISSUE 13 tentpole, part 2) ---------------
+
+import copy  # noqa: E402
+import json  # noqa: E402
+
+
+def _gang_manifest_rows():
+    with open(os.path.join(REPO, checkers_jaxpr.BUDGET_FILE)) as f:
+        return json.load(f)["gang_targets"]
+
+
+def _as_traced(rows):
+    return {name: dict(row, _dtype_bad=[]) for name, row in rows.items()}
+
+
+def test_gang_manifest_pins_three_plus_targets_with_link_split():
+    rows = _gang_manifest_rows()
+    assert len(rows) >= 3, sorted(rows)
+    for name, row in rows.items():
+        assert row["processes"] >= 2, name
+        assert row["processes"] * row["devices_per_process"] == 8, name
+        assert row["per_process_shard_shapes"], name
+        # the link split partitions bytes_by_kind exactly, per kind
+        for kind, b in row["bytes_by_kind"].items():
+            dcn = row["bytes_by_link"]["dcn"][kind]
+            ici = row["bytes_by_link"]["ici"][kind]
+            assert dcn + ici == b, (name, kind)
+            assert dcn > 0, (name, kind)   # a 2-process gang always
+            #                                crosses the DCN
+        assert row["dcn_bytes_per_step"] == sum(
+            row["bytes_by_link"]["dcn"].values()), name
+    # manifest rows self-check clean against themselves
+    assert checkers_jaxpr.check_gang_budget(REPO, _as_traced(rows)) == []
+
+
+def test_gang_doctored_dcn_byte_count_fails_jl203():
+    # the acceptance criterion: doctoring a DCN byte count fails JL203
+    rows = _as_traced(_gang_manifest_rows())
+    name = sorted(rows)[0]
+    row = copy.deepcopy(rows[name])
+    kind = sorted(row["bytes_by_link"]["dcn"])[0]
+    row["bytes_by_link"]["dcn"][kind] += 4096
+    row["dcn_bytes_per_step"] += 4096
+    doctored = dict(rows, **{name: row})
+    findings = checkers_jaxpr.check_gang_budget(REPO, doctored)
+    hits = [f for f in findings if f.code == "JL203" and f.func == name]
+    assert hits and "DCN" in hits[0].message, findings
+    assert not any(f.code == "JL201" and f.func == name for f in findings)
+
+
+def test_gang_doctored_shard_shape_fails_jl201():
+    rows = _as_traced(_gang_manifest_rows())
+    name = sorted(rows)[0]
+    row = copy.deepcopy(rows[name])
+    row["per_process_shard_shapes"][0][0] *= 2
+    findings = checkers_jaxpr.check_gang_budget(
+        REPO, dict(rows, **{name: row}))
+    hits = [f for f in findings if f.code == "JL201" and f.func == name]
+    assert hits and "shard shapes" in hits[0].message, findings
+
+
+def test_gang_missing_and_stale_rows_are_loud():
+    rows = _as_traced(_gang_manifest_rows())
+    # a gang target with no manifest row
+    extra = dict(rows)
+    extra["gang2x4_new_workload"] = copy.deepcopy(
+        rows[sorted(rows)[0]])
+    findings = checkers_jaxpr.check_gang_budget(REPO, extra)
+    assert any(f.code == "JL201" and "no manifest row" in f.message
+               for f in findings)
+    # a manifest row whose target vanished
+    short = dict(rows)
+    dropped = sorted(short)[0]
+    del short[dropped]
+    findings = checkers_jaxpr.check_gang_budget(REPO, short)
+    assert any(f.code == "JL201" and f.func == dropped
+               and "stale" in f.message for f in findings)
+
+
+def test_split_bytes_by_link_edge_model():
+    split = checkers_jaxpr.split_bytes_by_link
+    # ring kinds: P of W edges cross the DCN -> 2/8 here
+    out = split({"ppermute": 800}, world=8, processes=2,
+                devices_per_process=4, link_class="dcn")
+    assert out["dcn"]["ppermute"] == 200
+    assert out["ici"]["ppermute"] == 600
+    # all_to_all: W-D of W-1 peers are remote -> 4/7
+    out = split({"all_to_all": 700}, world=8, processes=2,
+                devices_per_process=4, link_class="dcn")
+    assert out["dcn"]["all_to_all"] == 400
+    assert out["ici"]["all_to_all"] == 300
+    # floor split still sums exactly on odd byte counts
+    out = split({"ppermute": 101}, world=8, processes=2,
+                devices_per_process=4, link_class="dcn")
+    assert out["dcn"]["ppermute"] + out["ici"]["ppermute"] == 101
+    # a single-pod gang (workers axis hinted ici) books everything as ICI
+    out = split({"ppermute": 800}, world=8, processes=2,
+                devices_per_process=4, link_class="ici")
+    assert out["dcn"]["ppermute"] == 0 and out["ici"]["ppermute"] == 800
+
+
+def test_gang_traced_budgets_match_committed_manifest(session):
+    # the end-to-end gate: retracing the gang registry on the live mesh
+    # reproduces the committed rows exactly (any drift is loud)
+    gang = checkers_jaxpr.trace_gang_all()
+    findings = checkers_jaxpr.check_gang_budget(REPO, gang)
+    assert findings == [], "\n".join(str(f) for f in findings)
+    assert len(gang) >= 3
+    for name, row in gang.items():
+        assert row["_dtype_bad"] == [], name
+
+
+# -- --json machine-readable output (ISSUE 13 satellite) --------------------
+
+
+def test_json_output_one_finding_per_line(tmp_path, capsys):
+    pkg = tmp_path / "harp_tpu" / "serve"
+    pkg.mkdir(parents=True)
+    (pkg / "racy.py").write_text(
+        "import threading\n"
+        "class Worker:\n"
+        "    def __init__(self):\n"
+        "        self._thread = threading.Thread(target=self._loop,\n"
+        "                                        daemon=True)\n"
+        "    def _loop(self):\n"
+        "        self.state = 1\n"
+        "    def poke(self):\n"
+        "        return self.state\n")
+    from tools.jaxlint.__main__ import main as jaxlint_main
+
+    rc = jaxlint_main([str(tmp_path), "--ast-only", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    lines = [json.loads(line) for line in out.strip().splitlines()]
+    assert lines, out
+    for rec in lines:
+        assert {"file", "line", "code", "checker", "func", "message",
+                "allowlisted"} <= set(rec), rec
+    jl301 = [r for r in lines if r["code"] == "JL301"]
+    assert jl301 and jl301[0]["file"] == "harp_tpu/serve/racy.py"
+    assert jl301[0]["line"] == 7 and jl301[0]["func"] == "_loop"
+    assert jl301[0]["allowlisted"] is False
+    # human-mode summary lines must NOT pollute the JSONL stream
+    assert not any(line.startswith(("ast engine", "jaxlint"))
+                   for line in out.strip().splitlines())
+
+
+def test_json_stale_allowlist_records_ride_the_jsonl_stream(tmp_path,
+                                                            capsys):
+    (tmp_path / "harp_tpu").mkdir()
+    (tmp_path / "harp_tpu" / "clean.py").write_text("X = 1\n")
+    from tools.jaxlint.__main__ import main as jaxlint_main
+
+    rc = jaxlint_main([str(tmp_path), "--ast-only", "--json"])
+    out = capsys.readouterr().out
+    # the fixture tree itself is clean, but the committed allowlist is
+    # stale against it — staleness must surface as machine-readable
+    # records on the same stream (and keep the nonzero exit), never as
+    # human prose polluting the JSONL
+    lines = [json.loads(line) for line in out.strip().splitlines()]
+    assert lines and all(rec["code"] == "stale-allowlist" for rec in lines)
+    assert rc == 1  # stale entries are findings by contract
+
+
+def test_json_deferred_callback_write_is_not_guard_shadowed():
+    # a closure DEFINED under a lock executes later without it: its
+    # unguarded write must still fire (the guard state does not leak in)
+    src = (
+        "class Worker:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._thread = threading.Thread(target=self._loop,\n"
+        "                                        daemon=True)\n"
+        "    def make_cb(self):\n"
+        "        with self._lock:\n"
+        "            def cb():\n"
+        "                self.state = 1\n"
+        "            self._cb = cb\n"
+        "    def _loop(self):\n"
+        "        self.state = 2\n"
+        "    def poke(self):\n"
+        "        return self.state\n")
+    got = _runc(src)
+    assert sorted((f.func, f.code) for f in got) == [
+        ("_loop", "JL301"), ("make_cb", "JL301")], got
+
+
+def test_jl301_nested_fn_thread_target_makes_method_a_root():
+    # a Thread targeting a function NESTED in a method: the closure's
+    # unguarded cross-thread write must fire (the enclosing method hosts
+    # the thread domain)
+    src = (
+        "class Worker:\n"
+        "    def start(self):\n"
+        "        def loop():\n"
+        "            self.state = 1\n"
+        "        threading.Thread(target=loop, daemon=True).start()\n"
+        "    def poke(self):\n"
+        "        return self.state\n")
+    got = _runc(src)
+    assert [(f.func, f.code) for f in got] == [("start", "JL301")], got

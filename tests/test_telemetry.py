@@ -638,3 +638,113 @@ def test_telemetry_overhead_cpu_smoke(session, rng, tmp_path):
     assert overhead_pct < 2.0, (
         f"telemetry per-step cost {per_event * 1e6:.1f}us is "
         f"{overhead_pct:.2f}% of the {step_s * 1e3:.2f}ms kmeans step")
+
+
+# --------------------------------------------------------------------------- #
+# Metrics thread safety (ISSUE 13 satellite: one lock over the registry,
+# reservoir adds lock-guarded — the load generator's per-thread-reservoir
+# workaround is now isolation, not a correctness requirement)
+# --------------------------------------------------------------------------- #
+
+def test_metrics_registry_loses_no_updates_under_contention():
+    import threading as th
+
+    m = Metrics()
+    n_threads, per = 8, 400
+    barrier = th.Barrier(n_threads)
+
+    def worker(i):
+        barrier.wait()
+        for j in range(per):
+            m.count("requests")
+            m.count("bytes", 3.0)
+            m.observe("latency", 0.001)
+            m.gauge(f"g{i}", float(j))
+
+    threads = [th.Thread(target=worker, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # counters: every increment survives (the JL302 lost-update class)
+    assert m.counters["requests"] == n_threads * per
+    assert m.counters["bytes"] == 3.0 * n_threads * per
+    # timers: exact count/total even though all threads shared ONE
+    # reservoir (pre-v3 this undercounted, hence the per-thread pattern)
+    assert m.timers["latency"].count == n_threads * per
+    assert abs(m.timers["latency"].total - 0.001 * n_threads * per) < 1e-6
+    snap = m.snapshot()
+    assert snap["counters"]["requests"] == n_threads * per
+    assert snap["timers"]["latency"]["count"] == n_threads * per
+
+
+def test_timer_reservoir_concurrent_adds_stay_exact_and_bounded():
+    import threading as th
+
+    r = TimerReservoir(cap=64)
+    n_threads, per = 8, 500
+    barrier = th.Barrier(n_threads)
+
+    def adder(i):
+        barrier.wait()
+        for j in range(per):
+            r.add(float(i * per + j))
+
+    threads = [th.Thread(target=adder, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert r.count == n_threads * per
+    assert r.total == sum(range(n_threads * per))
+    assert len(r.samples) == 64
+
+
+def test_metrics_snapshot_is_consistent_while_writers_insert():
+    # pre-v3 this raised "dictionary changed size during iteration" (the
+    # exporter mid-scrape race); now a snapshot is lock-consistent
+    import threading as th
+
+    m = Metrics()
+    stop = th.Event()
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            m.observe(f"timer.{i % 97}", 0.001)
+            m.count(f"counter.{i % 89}")
+            i += 1
+
+    t = th.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        for _ in range(60):
+            snap = m.snapshot()           # must never raise
+            assert isinstance(snap["timers"], dict)
+    finally:
+        stop.set()
+        t.join(5.0)
+
+
+def test_gang_collector_publish_is_scrape_consistent(session, tmp_path):
+    # the PR 12 hand-review race, now fixed + linted (JL301): the
+    # collector publishes (snapshots, report) atomically under its lock,
+    # and the exporter's gang= source reads through the same lock
+    from harp_tpu.telemetry.gang import GangCollector
+
+    m = Metrics()
+    for _ in range(4):
+        m.observe("telemetry.step.fake", 0.01)
+    log = step_log.StepLog(str(tmp_path), interval=1, rank=0, metrics=m)
+    collector = GangCollector(session, str(tmp_path), every=1)
+    assert collector.snapshots() is None and collector.last_report is None
+    collector(1 * log.interval, log)      # one boundary publish
+    # the pair-consistent accessor: (snapshots, report) from ONE publish
+    snaps, report = collector.last_exchange()
+    assert snaps is not None and 0 in snaps
+    assert snaps[0]["timers"]["telemetry.step.fake"]["count"] == 4
+    assert report is not None and report["num_ranks"] == 1
+    # the property surface and the exporter source return the same object
+    assert collector.snapshots() is snaps or collector.snapshots() == snaps
+    assert collector.last_snapshots is snaps or \
+        collector.last_snapshots == snaps

@@ -22,7 +22,7 @@
 #                     sneaking into the resident predict dispatch, or its
 #                     bytes growing, fails JL201/JL203; the one-compile-
 #                     per-(model,bucket) retrace contract is asserted by
-#                     tests/test_serve.py in stage 4.
+#                     tests/test_serve.py in stage 5.
 #                     r12: the manifest also pins the ON-DEVICE RESHARD
 #                     step programs (collectives/reshard.py):
 #                     reshard_factor_a2a at ONE all_to_all whose operand
@@ -34,8 +34,17 @@
 #                     or a rebalance adding a collective to the request
 #                     path, fails JL201/JL203; bitwise parity vs the
 #                     numpy oracle is asserted by tests/test_reshard.py
-#                     in stage 4);
+#                     in stage 5);
 #                     nonzero on any finding or stale allowlist entry.
+#                     r13 (ISSUE 13): stage 1 also runs the JL3xx
+#                     CONCURRENCY engine (checkers_threads.py) over the
+#                     threaded host plane (serve/, telemetry/, parallel/,
+#                     sched/): unguarded shared writes (JL301),
+#                     unsynchronized read-modify-writes (JL302), lock-order
+#                     inversions (JL303), and thread-lifecycle hygiene
+#                     (JL304) — the hand-review race class of PRs 10-12 is
+#                     now a lint, with every benign exception individually
+#                     justified in the allowlist.
 #   2. telemetry    — the jaxpr engine re-run with the gang telemetry layer
 #                     ENABLED (HARP_TELEMETRY_DIR set): the instrumented
 #                     step programs must reproduce the pinned manifest
@@ -48,10 +57,20 @@
 #                     so the serve_* dispatch targets (and everything else)
 #                     must stay byte-identical with per-request spans on.
 #                     The exporter /metrics//snapshot//gang schema smoke
-#                     and the watchdog/skew/span tests ride stage 4
+#                     and the watchdog/skew/span tests ride stage 5
 #                     (tests/test_serve_observability.py).
-#   3. check_claims — README/PERF headline numbers vs BENCH_local.json.
-#   4. tier-1       — the ROADMAP.md verify suite (which itself re-runs
+#   3. gang budgets — the jaxpr engine's GANG MODE only (ISSUE 13, the
+#                     carried "jaxlint multi-host budgets" item): the
+#                     dryrun_multichip step programs traced on the virtual
+#                     2-host x 4-device mesh with the workers axis hinted
+#                     DCN, pinned per target as collective counts,
+#                     per-process shard shapes, and bytes_by_kind split by
+#                     LINK CLASS (DCN vs ICI, mesh.axis_link_class) — a
+#                     gang program whose DCN bytes grow, or whose
+#                     per-process shard shape drifts, fails JL203/JL201
+#                     exactly like the single-process targets.
+#   4. check_claims — README/PERF headline numbers vs BENCH_local.json.
+#   5. tier-1       — the ROADMAP.md verify suite (which itself re-runs
 #                     jaxlint's clean-repo + budget checks as tests, so
 #                     DOTS_PASSED captures them).
 #
@@ -62,18 +81,29 @@ set -u
 cd "$(dirname "$0")/.."
 rc=0
 
-echo "== [1/4] jaxlint =="
+echo "== [1/5] jaxlint (AST + JL3xx concurrency + jaxpr + gang budgets) =="
 python -m tools.jaxlint || rc=1
 
-echo "== [2/4] jaxlint budget with telemetry + request tracing ON (zero drift) =="
+echo "== [2/5] jaxlint budget with telemetry + request tracing ON (zero drift) =="
 tele_dir="$(mktemp -d /tmp/_tele_gate.XXXXXX)"
 HARP_TELEMETRY_DIR="$tele_dir" HARP_TRACE_REQUESTS=1 \
     python -m tools.jaxlint --jaxpr-only || rc=1
 
-echo "== [3/4] check_claims =="
+echo "== [3/5] gang-mode collective budgets (virtual multi-process mesh) =="
+# ISSUE 13: the dryrun_multichip gang-mode step programs traced on the
+# virtual 2-host x 4-device mesh with the workers axis hinted DCN —
+# counts, per-process shard shapes, and the DCN/ICI link-class byte split
+# all pinned against tools/collective_budget.json's gang_targets rows
+# (JL201/JL203). --update-budget regenerates the gang rows with the rest.
+# Stages 1 and 2 DO already trace the gang registry; this dedicated pass
+# (4 targets, seconds) exists so a gang-budget failure is attributable to
+# its own stage banner in CI output instead of buried in stage 1's.
+python -m tools.jaxlint --gang-only || rc=1
+
+echo "== [4/5] check_claims =="
 python tools/check_claims.py || rc=1
 
-echo "== [4/4] tier-1 tests =="
+echo "== [5/5] tier-1 tests =="
 set -o pipefail
 t1_log="$(mktemp /tmp/_t1.XXXXXX.log)"   # unique per run: concurrent CI
 trap 'rm -f "$t1_log"; rm -rf "$tele_dir"' EXIT   # must not clobber the count

@@ -1,14 +1,24 @@
 """jaxlint runner: ``python -m tools.jaxlint [options] [repo_root]``.
 
 Exit status is nonzero on ANY active finding, stale allowlist entry,
-allowlist schema error, or collective-budget drift. ``--update-budget``
-retraces every registry target and rewrites ``tools/collective_budget.json``
-(commit the diff deliberately — it is the per-step communication contract).
+allowlist schema error, or collective-budget drift (single-process AND
+gang-mode rows). ``--update-budget`` retraces every registry target — both
+engines — and rewrites ``tools/collective_budget.json`` (commit the diff
+deliberately — it is the per-step communication contract).
+
+``--json`` emits machine-readable findings, one JSON object per line
+(``{"file", "line", "code", "checker", "func", "message", "allowlisted"}``;
+stale allowlist entries ride the same stream with ``"code":
+"stale-allowlist"``), so CI annotators and editors consume findings without
+parsing the human text. Allowlisted findings are INCLUDED (flagged true) —
+an editor wants to show the suppressed finding with its justification
+context, and CI wants to count them; the exit code still ignores them.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -16,24 +26,40 @@ import sys
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.jaxlint",
-        description="AST + jaxpr static analysis for harp_tpu")
+        description="AST + jaxpr + concurrency static analysis for harp_tpu")
     parser.add_argument("root", nargs="?", default=None,
                         help="repo root (default: the checkout this file "
                              "lives in)")
     parser.add_argument("--ast-only", action="store_true",
-                        help="skip the jaxpr engine (no model tracing)")
+                        help="skip the jaxpr engines (no model tracing)")
     parser.add_argument("--jaxpr-only", action="store_true",
-                        help="skip the AST engine")
+                        help="skip the AST engine (still traces both the "
+                             "single-process and gang-mode registries)")
+    parser.add_argument("--gang-only", action="store_true",
+                        help="trace ONLY the gang-mode registry (the CI "
+                             "gang-budget stage: virtual multi-process "
+                             "mesh, counts/kinds/link-class bytes vs the "
+                             "manifest)")
     parser.add_argument("--update-budget", action="store_true",
-                        help="retrace all targets and rewrite "
-                             "tools/collective_budget.json")
+                        help="retrace all targets (both engines) and "
+                             "rewrite tools/collective_budget.json")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="one finding per line as JSON (file, line, "
+                             "code, message, allowlisted flag)")
     args = parser.parse_args(argv)
-    if args.ast_only and args.jaxpr_only:
-        parser.error("--ast-only and --jaxpr-only are mutually exclusive "
+    if args.ast_only and (args.jaxpr_only or args.gang_only):
+        parser.error("--ast-only excludes --jaxpr-only/--gang-only "
                      "(together they would check nothing and report clean)")
+    if args.jaxpr_only and args.gang_only:
+        parser.error("--jaxpr-only and --gang-only are mutually exclusive "
+                     "(--gang-only would silently skip the single-process "
+                     "budget check --jaxpr-only asks for)")
     if args.ast_only and args.update_budget:
-        parser.error("--update-budget needs the jaxpr engine; drop "
+        parser.error("--update-budget needs the jaxpr engines; drop "
                      "--ast-only")
+    if args.gang_only and args.update_budget:
+        parser.error("--update-budget retraces BOTH registries so the "
+                     "manifest stays whole; drop --gang-only")
 
     root = args.root or os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
@@ -47,41 +73,76 @@ def main(argv=None) -> int:
 
     problems = 0
 
+    def out_finding(f, allowlisted: bool) -> None:
+        if args.as_json:
+            print(json.dumps({
+                "file": f.path, "line": f.line, "code": f.code,
+                "checker": f.checker, "func": f.func, "message": f.message,
+                "allowlisted": allowlisted}))
+        elif not allowlisted:
+            print(f)
+
+    def out_note(msg: str, code: str = "stale-allowlist") -> None:
+        if args.as_json:
+            print(json.dumps({"file": "tools/jaxlint/allowlist.py",
+                              "line": 0, "code": code, "checker": code,
+                              "func": "<allowlist>", "message": msg,
+                              "allowlisted": False}))
+        else:
+            print(msg)
+
+    def status(msg: str) -> None:
+        # progress/summary lines stay off stdout in --json mode so the
+        # stream is pure JSONL for machine consumers
+        if not args.as_json:
+            print(msg)
+
     schema_errors = validate_allowlist(ALLOWLIST)
     for e in schema_errors:
-        print(f"allowlist schema: {e}")
+        out_note(f"allowlist schema: {e}", code="allowlist-schema")
     problems += len(schema_errors)
 
-    if not args.jaxpr_only:
+    if not (args.jaxpr_only or args.gang_only):
         raw = run_ast_checkers(root, ast_checkers_for_repo(root))
         active, stale = apply_allowlist(raw, ALLOWLIST)
-        for f in active:
-            print(f)
+        active_keys = {id(f) for f in active}
+        for f in raw:
+            out_finding(f, allowlisted=id(f) not in active_keys)
         for s in stale:
-            print(s)
+            out_note(s)
         problems += len(active) + len(stale)
-        print(f"ast engine: {len(active)} finding(s), {len(stale)} stale "
-              f"allowlist entr(ies)")
+        status(f"ast engine: {len(active)} finding(s), {len(stale)} stale "
+               f"allowlist entr(ies)")
 
     if not args.ast_only:
         from tools.jaxlint import checkers_jaxpr
 
-        traced = checkers_jaxpr.trace_all()
+        traced = None
+        if not args.gang_only:
+            traced = checkers_jaxpr.trace_all()
+        gang = checkers_jaxpr.trace_gang_all()
         if args.update_budget:
-            path = checkers_jaxpr.write_budget(root, traced)
-            print(f"wrote {os.path.relpath(path, root)} "
-                  f"({len(traced)} targets)")
-        budget_findings = checkers_jaxpr.check_budget(root, traced)
-        for f in budget_findings:
-            print(f)
-        problems += len(budget_findings)
-        print(f"jaxpr engine: {len(traced)} targets traced, "
-              f"{len(budget_findings)} finding(s)")
+            path = checkers_jaxpr.write_budget(root, traced, gang)
+            status(f"wrote {os.path.relpath(path, root)} "
+                   f"({len(traced)} targets, {len(gang)} gang targets)")
+        if traced is not None:
+            budget_findings = checkers_jaxpr.check_budget(root, traced)
+            for f in budget_findings:
+                out_finding(f, allowlisted=False)
+            problems += len(budget_findings)
+            status(f"jaxpr engine: {len(traced)} targets traced, "
+                   f"{len(budget_findings)} finding(s)")
+        gang_findings = checkers_jaxpr.check_gang_budget(root, gang)
+        for f in gang_findings:
+            out_finding(f, allowlisted=False)
+        problems += len(gang_findings)
+        status(f"gang engine: {len(gang)} gang-mode targets traced, "
+               f"{len(gang_findings)} finding(s)")
 
     if problems:
-        print(f"jaxlint: {problems} problem(s)")
+        status(f"jaxlint: {problems} problem(s)")
         return 1
-    print("jaxlint: clean")
+    status("jaxlint: clean")
     return 0
 
 

@@ -67,6 +67,32 @@ ALLOWLIST: Allowlist = {
         "(reference's concurrent simuOverhead); unsynced dispatches would "
         "overlap the sleeps and void the model",
 
+    # -- JL3xx concurrency: benign-by-design cross-thread state ------------
+    ("harp_tpu/parallel/failure.py", "_loop", "JL301"):
+        "sticky fail-stop flag: the heartbeat thread only ever flips "
+        "failed False->True and the main thread only reads it in ok() — "
+        "monotonic single-writer boolean, GIL-atomic store, and a missed "
+        "read costs one extra probe interval, never a lost failure (ok() "
+        "keeps raising once set); a lock would add nothing but overhead "
+        "on the per-iteration hot path",
+    ("harp_tpu/telemetry/xprof.py", "_start", "JL301"):
+        "XprofController state (trace_dir, remaining) is single-threaded "
+        "by the StepLog contract: boundary hooks run ONLY on the training "
+        "loop thread (add_boundary_hook docstring), and the cross-thread "
+        "handoff is the trigger FILE polled by (mtime, size) token — the "
+        "controller attrs never cross a thread",
+    ("harp_tpu/telemetry/xprof.py", "_stop", "JL301"):
+        "same StepLog single-thread contract as _start: remaining is only "
+        "touched from boundary hooks on the training loop thread; the "
+        "operator-facing side is the atomically-replaced trigger file, "
+        "not these attributes",
+    ("harp_tpu/telemetry/xprof.py", "__call__", "JL302"):
+        "remaining -= 1 runs only on the training loop thread (StepLog "
+        "boundary hooks are single-threaded by contract); the __call__ "
+        "hook heuristic assumes callbacks may cross threads, which the "
+        "xprof controller deliberately never does (its module docstring "
+        "calls out why collective ops must stay boundary-aligned)",
+
     # -- JL105 broad-except: blast radius deliberately wide ----------------
     ("harp_tpu/parallel/p2p.py", "_reader", "JL105"):
         "an undecodable peer payload (gang version skew) can raise "

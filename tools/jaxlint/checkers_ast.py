@@ -518,6 +518,10 @@ AST_CHECKERS = [
 
 
 def ast_checkers_for_repo(repo_root: str):
+    # the JL3xx concurrency engine rides the same registry: one walk of the
+    # tree serves the lexical checkers and the thread-domain inference
+    from tools.jaxlint.checkers_threads import check_concurrency
+
     return [
         check_collective_divergence,
         make_axis_name_checker(gather_canonical_axes(repo_root)),
@@ -525,4 +529,5 @@ def ast_checkers_for_repo(repo_root: str):
         check_host_sync,
         check_broad_except,
         check_scatter,
+        check_concurrency,
     ]

@@ -32,6 +32,7 @@ executed, so the whole budget check runs in tier-1 on the virtual CPU mesh.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 from typing import Dict, List, Optional, Tuple
@@ -156,6 +157,145 @@ def trace_all() -> Dict[str, Tuple[Dict[str, int], List[str],
             for name in sorted(trace_targets.TARGETS)}
 
 
+# --------------------------------------------------------------------------
+# gang mode (ISSUE 13): per-process shard shapes + DCN/ICI byte split
+# --------------------------------------------------------------------------
+#
+# Wire model for the link split (EQuARX-style accounting, arXiv:2506.17615,
+# applied to the DCN/ICI boundary that DrJAX-style multi-mesh programs make
+# first-class, arXiv:2403.07128). The gang lays the workers axis out
+# contiguously per process (make_mesh over distributed.initialize's device
+# order — mp_smoke's layout), so on the W-worker ring exactly P of the W
+# hop edges cross a process (= host = DCN) boundary:
+#
+# * ring-scheduled kinds (ppermute and the pshuffle permutation, the fused
+#   ring-DMA hops, and the reduction/gather family XLA lowers to ring
+#   schedules on a 1-D axis): DCN share = P / W of the operand bytes.
+# * all_to_all: every worker exchanges with W-1 peers, of which W - D sit
+#   on other hosts: DCN share = (W - D) / (W - 1).
+#
+# Shares are integer floor (DCN rounds down, ICI takes the remainder), so
+# the split is deterministic and sums exactly to bytes_by_kind. The split
+# only applies when the workers axis is hinted "dcn"
+# (mesh.set_axis_link_class — gang launchers do this at bootstrap; a
+# single-pod gang's hint stays "ici" and every byte books as ICI).
+
+_ALL_TO_ALL_KINDS = {"all_to_all"}     # pshuffle is a permutation — ring
+#                                        model, like ppermute
+
+
+def split_bytes_by_link(nbytes: Dict[str, int], *, world: int,
+                        processes: int, devices_per_process: int,
+                        link_class: str) -> Dict[str, Dict[str, int]]:
+    """``bytes_by_kind`` split into ``{"dcn": {...}, "ici": {...}}``."""
+    dcn: Dict[str, int] = {}
+    ici: Dict[str, int] = {}
+    for kind, b in sorted(nbytes.items()):
+        if link_class != "dcn" or processes <= 1 or world <= 1:
+            num, den = 0, 1
+        elif kind in _ALL_TO_ALL_KINDS:
+            num, den = world - devices_per_process, world - 1
+        else:
+            num, den = processes, world
+        d = b * num // den
+        dcn[kind] = d
+        ici[kind] = b - d
+    return {"dcn": dcn, "ici": ici}
+
+
+def per_process_shard_shapes(args, devices_per_process: int) -> List[list]:
+    """The per-PROCESS block shape of every traced program input.
+
+    A replicated dim keeps its global extent; a dim sharded over the
+    workers axis scales the per-device shard by the process's local device
+    count. This is the layout each host actually materializes — the
+    resharding contract the fleet item moves against (arXiv:2112.01075)."""
+    import jax
+
+    shapes: List[list] = []
+    for leaf in jax.tree_util.tree_leaves(args):
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            continue
+        shape = tuple(int(s) for s in shape)
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is None:
+            shapes.append(list(shape))        # host array: fully replicated
+            continue
+        try:
+            shard = tuple(int(s) for s in sharding.shard_shape(shape))
+        except (TypeError, ValueError):
+            shapes.append(list(shape))
+            continue
+        shapes.append([g if s == g else min(g, s * devices_per_process)
+                       for g, s in zip(shape, shard)])
+    return shapes
+
+
+@contextlib.contextmanager
+def _gang_link_hint(link_class: str):
+    """Hint the workers axis for the duration of one gang trace, restoring
+    the ambient hint after (the hint is process-global mesh state)."""
+    from harp_tpu.parallel import mesh as mesh_lib
+
+    prev = mesh_lib.axis_link_class(mesh_lib.WORKERS)
+    mesh_lib.set_axis_link_class(mesh_lib.WORKERS, link_class)
+    try:
+        yield
+    finally:
+        mesh_lib.set_axis_link_class(mesh_lib.WORKERS, prev)
+
+
+def trace_gang_target(name: str) -> dict:
+    """Trace one gang-mode target under the DCN hint; returns the full
+    manifest-row dict (counts, dtype issues, bytes, shard shapes, link
+    split).
+
+    The DCN hint is live DURING tracing, so link-aware code paths (the
+    rotation pipeline's DCN chunking) trace their actual cross-pod
+    program — the gang row pins the program a real 2-host gang runs, not
+    the single-pod one retitled.
+    """
+    import jax
+
+    from harp_tpu.parallel import mesh as mesh_lib
+    from tools.jaxlint import trace_targets
+
+    P = trace_targets.GANG_PROCESSES
+    D = trace_targets.GANG_DEVICES_PER_PROCESS
+    with _gang_link_hint("dcn"):
+        fn, args = trace_targets.GANG_TARGETS[name]()
+        closed = jax.make_jaxpr(fn)(*args)
+        counts: Dict[str, int] = {}
+        dtype_bad: List[str] = []
+        nbytes: Dict[str, int] = {}
+        _walk(closed.jaxpr, counts, dtype_bad, nbytes)
+        link = mesh_lib.axis_link_class(mesh_lib.WORKERS)
+        by_link = split_bytes_by_link(
+            nbytes, world=trace_targets.NUM_WORKERS, processes=P,
+            devices_per_process=D, link_class=link)
+        shard_shapes = per_process_shard_shapes(args, D)
+    return {
+        "processes": P,
+        "devices_per_process": D,
+        "collectives": dict(sorted(counts.items())),
+        "per_process_shard_shapes": shard_shapes,
+        "bytes_per_step": sum(nbytes.values()),
+        "bytes_by_kind": dict(sorted(nbytes.items())),
+        "bytes_by_link": by_link,
+        "dcn_bytes_per_step": sum(by_link["dcn"].values()),
+        "_dtype_bad": dtype_bad,     # stripped before the manifest write
+    }
+
+
+def trace_gang_all() -> Dict[str, dict]:
+    from tools.jaxlint import trace_targets
+
+    trace_targets.ensure_cpu_mesh()
+    return {name: trace_gang_target(name)
+            for name in sorted(trace_targets.GANG_TARGETS)}
+
+
 def load_budget(repo_root: str) -> Optional[dict]:
     path = os.path.join(repo_root, BUDGET_FILE)
     if not os.path.exists(path):
@@ -164,9 +304,20 @@ def load_budget(repo_root: str) -> Optional[dict]:
         return json.load(f)
 
 
-def write_budget(repo_root: str, traced) -> str:
+def write_budget(repo_root: str, traced, gang=None) -> str:
+    """Rewrite the manifest from ``traced`` (and ``gang``, the gang-mode
+    rows from :func:`trace_gang_all`; None carries the committed gang rows
+    forward unchanged so a single-engine regenerate can't silently drop
+    the gang contract)."""
     import jax
 
+    if gang is None:
+        existing = load_budget(repo_root) or {}
+        gang_rows = existing.get("gang_targets", {})
+    else:
+        gang_rows = {name: {k: v for k, v in row.items()
+                            if not k.startswith("_")}
+                     for name, row in sorted(gang.items())}
     path = os.path.join(repo_root, BUDGET_FILE)
     doc = {
         "_contract": (
@@ -183,7 +334,16 @@ def write_budget(repo_root: str, traced) -> str:
             "the bytes that move via in-kernel ring DMA "
             "(ops/ring_dma fused hops — tagged jits on the tracing mesh): "
             "a fused schedule silently reverting to bare ppermute moves "
-            "these bytes between kinds and fails the gate."),
+            "these bytes between kinds and fails the gate. gang_targets "
+            "pin the dryrun_multichip GANG-MODE step programs: the same "
+            "step traced under the declared processes x devices_per_process "
+            "topology with the workers axis hinted DCN — each row adds "
+            "per_process_shard_shapes (what every HOST holds; drift is a "
+            "partitioning-contract break, JL201) and bytes_by_link "
+            "(bytes_by_kind split DCN vs ICI by the ring-edge/peer model "
+            "in checkers_jaxpr.split_bytes_by_link; grown DCN bytes at "
+            "fixed counts is the cross-pod regression single-process rows "
+            "cannot see, JL203)."),
         "traced_with_jax": jax.__version__,
         "targets": {
             name: {
@@ -193,6 +353,7 @@ def write_budget(repo_root: str, traced) -> str:
                 "fused_dma_bytes_per_step": nbytes.get("fused_dma", 0),
             }
             for name, (counts, _bad, nbytes) in sorted(traced.items())},
+        "gang_targets": gang_rows,
     }
     with open(path, "w", encoding="utf-8") as f:
         json.dump(doc, f, indent=2, sort_keys=False)
@@ -292,4 +453,98 @@ def check_budget(repo_root: str, traced=None) -> List[Finding]:
         emit("JL201", "collective-budget", name,
              f"manifest entry {name!r} matches no trace target — stale row "
              f"(target renamed/removed); regenerate with --update-budget")
+    return findings
+
+
+def check_gang_budget(repo_root: str, gang=None) -> List[Finding]:
+    """JL201/JL202/JL203 for the gang-mode rows (module docstring: the
+    gang split of counts, per-process shard shapes, and DCN/ICI bytes)."""
+    if gang is None:
+        gang = trace_gang_all()
+    findings: List[Finding] = []
+
+    def emit(code, checker, target, msg):
+        findings.append(Finding(
+            code=code, checker=checker, path=BUDGET_FILE, line=1,
+            func=target, message=msg))
+
+    budget = load_budget(repo_root)
+    pinned_rows = (budget or {}).get("gang_targets", {})
+    if budget is not None and not pinned_rows and gang:
+        emit("JL201", "gang-budget", "<manifest>",
+             f"{BUDGET_FILE} has no gang_targets section but "
+             f"{len(gang)} gang-mode targets trace — regenerate with "
+             f"`python -m tools.jaxlint --update-budget` and commit the "
+             f"gang rows")
+    for name, row in sorted(gang.items()):
+        for issue in row.get("_dtype_bad", []):
+            emit("JL202", "dtype-policy", name, issue)
+        if budget is None or name not in pinned_rows:
+            if budget is not None and pinned_rows:
+                emit("JL201", "gang-budget", name,
+                     f"gang-mode target {name!r} has no manifest row — "
+                     f"run --update-budget and review the new row")
+            continue
+        pinned = pinned_rows[name]
+        # topology + counts + per-process shard shapes: JL201 (a changed
+        # shard shape means each host holds a different block — the
+        # partitioning contract moved, not just its cost)
+        for key, label in (("processes", "process count"),
+                           ("devices_per_process", "devices per process"),
+                           ("collectives", "collective counts"),
+                           ("per_process_shard_shapes",
+                            "per-process shard shapes")):
+            if row.get(key) != pinned.get(key):
+                emit("JL201", "gang-budget", name,
+                     f"gang-mode {label} drift: traced {row.get(key)} vs "
+                     f"pinned {pinned.get(key)} — if intentional, "
+                     f"regenerate with --update-budget and review the "
+                     f"diff; if not, the gang step program (or its "
+                     f"per-host partitioning) changed")
+        # bytes: JL203, with the DCN split called out separately — DCN is
+        # the scarce link, so its growth is the headline even when totals
+        # barely move
+        traced_link = row.get("bytes_by_link", {})
+        pinned_link = pinned.get("bytes_by_link", {})
+        if pinned.get("bytes_per_step") is None:
+            emit("JL203", "gang-budget", name,
+                 f"gang manifest row {name!r} has no bytes_per_step — "
+                 f"regenerate with --update-budget so the gang byte "
+                 f"contract covers it")
+        elif (row.get("bytes_per_step") != pinned.get("bytes_per_step")
+              or row.get("bytes_by_kind") != pinned.get("bytes_by_kind")
+              or traced_link != pinned_link):
+            drift = []
+            for link in ("dcn", "ici"):
+                got_k = traced_link.get(link, {})
+                want_k = pinned_link.get(link, {})
+                for kind in sorted(set(got_k) | set(want_k)):
+                    g, w = got_k.get(kind, 0), want_k.get(kind, 0)
+                    if g != w:
+                        drift.append(f"{link}/{kind}: traced {g} B vs "
+                                     f"pinned {w} B")
+            if row.get("bytes_per_step") != pinned.get("bytes_per_step"):
+                drift.append(f"total: traced {row.get('bytes_per_step')} B "
+                             f"vs pinned {pinned.get('bytes_per_step')} B")
+            dcn_got = row.get("dcn_bytes_per_step", 0)
+            dcn_want = pinned.get("dcn_bytes_per_step", 0)
+            headline = (f"DCN bytes {dcn_got} vs pinned {dcn_want} — "
+                        if dcn_got != dcn_want else "")
+            emit("JL203", "gang-budget", name,
+                 f"gang-mode byte-budget drift ({headline}"
+                 f"{'; '.join(drift) or 'kind-level split moved'}) — "
+                 f"cross-pod comm volume changed at tier-1 shapes; if "
+                 f"intentional, --update-budget and review the diff")
+        elif (pinned.get("dcn_bytes_per_step") is not None
+              and pinned["dcn_bytes_per_step"]
+              != sum(pinned_link.get("dcn", {}).values())):
+            emit("JL203", "gang-budget", name,
+                 f"gang manifest inconsistency for {name!r}: "
+                 f"dcn_bytes_per_step={pinned['dcn_bytes_per_step']} "
+                 f"disagrees with its bytes_by_link dcn sum — hand-edited "
+                 f"row? regenerate with --update-budget")
+    for name in sorted(set(pinned_rows) - set(gang)):
+        emit("JL201", "gang-budget", name,
+             f"gang manifest row {name!r} matches no gang-mode trace "
+             f"target — stale row; regenerate with --update-budget")
     return findings

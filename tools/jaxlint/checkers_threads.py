@@ -1,0 +1,686 @@
+"""JL3xx concurrency checkers — the threaded-host-plane half of jaxlint.
+
+Harp's value proposition is MPI-style collectives fused into a threaded
+host runtime, and this repo now has exactly that shape: receive/serve
+loops (``serve/router.py``), micro-batcher threads (``serve/batcher.py``),
+exporter scrape threads (``telemetry/exporter.py``), watchdog and probe
+threads (``parallel/failure.py``), scheduler pools (``sched/``). The race
+bugs in that plane — ``StepLog.flush``'s drain, ``SLOWatchdog.observe``,
+the exporter mid-scrape snapshot race, ``TimerReservoir.add`` — were each
+caught only by hand review in PRs 10–12. This module turns that review
+into a lint.
+
+Codes:
+  JL301 unguarded-shared-write    an instance attribute REBOUND (or a
+                                  container field mutated) from a method
+                                  reachable from two thread domains — or
+                                  from a thread/callback entry writing a
+                                  PUBLIC attribute, the class's read
+                                  surface for other threads — with no
+                                  enclosing ``with <lock>``.
+  JL302 unsynchronized-rmw        a read-modify-write on shared state:
+                                  ``self.x += ...`` (load + store, a lost
+                                  update under interleaving) or
+                                  check-then-act on a shared dict/deque
+                                  (``if k in self.d: ... self.d[k]`` races
+                                  a concurrent pop between test and use).
+  JL303 lock-order-inversion      two methods of one class acquire the
+                                  same two locks in OPPOSITE nesting
+                                  order (directly, or via an intra-class
+                                  call made while holding a lock) — the
+                                  classic ABBA deadlock, which no test
+                                  catches until the 3am hang.
+  JL304 thread-lifecycle          a non-daemon thread with no ``join``
+                                  on any close path: interpreter exit
+                                  blocks on it forever (the atexit-close
+                                  contract every host-plane class carries
+                                  exists precisely to prevent this).
+
+Thread-domain inference (class-local, deliberately conservative):
+
+* **thread roots** — methods passed as ``threading.Thread(target=...)``
+  (including nested functions defined inside a method, attributed to it),
+  ``atexit.register``\\ ed methods, HTTP handler methods (``do_GET`` ...),
+  and ``__call__`` (the hook/callback protocol: boundary hooks and reply
+  callbacks are registered by one thread and invoked by another — the
+  GangCollector/exporter ``/gang`` race of PR 12 lived exactly there).
+* a method reachable (via ``self.m()`` calls) from a root runs on that
+  root's thread; everything else is the "main" domain (public API runs on
+  whatever thread calls it).
+* an attribute is SHARED when its accesses span >= 2 domains, or when a
+  non-main domain writes a public attribute (other threads read public
+  attributes by convention; ``__init__`` writes are construction-time and
+  never count).
+* a write is GUARDED when lexically inside ``with self.<lock>`` (any
+  attribute assigned ``threading.Lock/RLock/Condition()`` in the class,
+  or whose name contains ``lock``/``cv``/``mutex``), or when the
+  enclosing method follows the ``*_locked`` naming contract (documented
+  caller-holds-the-lock).
+
+Scope: only the threaded host-plane trees (``HOST_TREES``) — device code
+and models run single-threaded SPMD and would drown the signal.
+
+Suppression rides the shared allowlist (``(file, function, code)`` keys
+with mandatory justifications; stale entries fail the run) — a benign
+race (a sticky fail flag, a monotonic watermark) is allowlisted with its
+reason, never silently skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.jaxlint.core import Finding
+
+HOST_TREES = (
+    "harp_tpu/serve/",
+    "harp_tpu/telemetry/",
+    "harp_tpu/parallel/",
+    "harp_tpu/sched/",
+)
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+_SYNC_FACTORIES = _LOCK_FACTORIES | {"Event", "Semaphore", "BoundedSemaphore",
+                                     "Barrier"}
+_LOCKISH_NAME_PARTS = ("lock", "mutex", "_cv")
+_HTTP_HANDLERS = {"do_GET", "do_POST", "do_PUT", "do_HEAD", "do_DELETE"}
+# container-mutating method calls on self.<attr>.<m>(...) that write state
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert", "add",
+             "update", "setdefault", "pop", "popleft", "popitem", "remove",
+             "discard", "clear"}
+# reads of self.<attr>.<m>(...) used in check-then-act tests
+_CHECK_READS = {"get", "keys", "items", "values", "__contains__"}
+
+
+def _call_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'x' for a plain ``self.x`` attribute node, else None."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _is_sync_factory_call(node: ast.AST) -> Optional[str]:
+    """'Lock'/'Event'/... when node is ``threading.Lock()`` / ``Lock()``."""
+    if isinstance(node, ast.Call):
+        name = _call_name(node.func)
+        if name in _SYNC_FACTORIES:
+            return name
+    return None
+
+
+def _lockish(attr: str) -> bool:
+    low = attr.lower()
+    return any(p in low for p in _LOCKISH_NAME_PARTS)
+
+
+class _Access:
+    """One instance-attribute access inside a method body."""
+
+    __slots__ = ("attr", "kind", "node", "guarded", "checked_first")
+
+    def __init__(self, attr: str, kind: str, node: ast.AST, guarded: bool,
+                 checked_first: bool = False):
+        self.attr = attr
+        self.kind = kind          # "read" | "write" | "aug" | "mut" | "sub"
+        self.node = node
+        self.guarded = guarded
+        self.checked_first = checked_first   # mutation inside an unguarded
+        #                                      membership/emptiness check on
+        #                                      the same attr (check-then-act)
+
+    @property
+    def writes(self) -> bool:
+        return self.kind != "read"
+
+
+class _MethodScan(ast.NodeVisitor):
+    """Walk ONE method body (nested functions attributed to the method,
+    nested classes skipped — they are analyzed as their own class)."""
+
+    def __init__(self, lock_attrs: Set[str], method_name: str):
+        self.lock_attrs = lock_attrs
+        self.always_guarded = method_name.endswith("_locked")
+        self.accesses: List[_Access] = []
+        self.calls_self: Set[str] = set()
+        self.thread_targets: Set[str] = set()       # self.<m> Thread targets
+        self.atexit_targets: Set[str] = set()
+        self.threads: List[dict] = []               # Thread() creations
+        self.lock_pairs: List[Tuple[str, str, ast.AST]] = []   # (outer, inner)
+        self.calls_under_lock: List[Tuple[str, str, ast.AST]] = []
+        self.locks_acquired: Set[str] = set()
+        self._held: List[str] = []                  # lock-attr stack
+        self._checked: List[Set[str]] = []          # check-then-act scopes
+
+    # -- helpers ------------------------------------------------------------
+
+    def _guarded(self) -> bool:
+        return self.always_guarded or bool(self._held)
+
+    def _checked_unguarded(self, attr: str) -> bool:
+        return any(attr in scope for scope in self._checked)
+
+    def _record(self, attr: str, kind: str, node: ast.AST) -> None:
+        self.accesses.append(_Access(
+            attr, kind, node, self._guarded(),
+            checked_first=(kind != "read"
+                           and self._checked_unguarded(attr))))
+
+    def _lock_name(self, expr: ast.AST) -> Optional[str]:
+        """The lock identity a ``with`` context expr acquires, if any."""
+        attr = _self_attr(expr)
+        if attr is not None and (attr in self.lock_attrs or _lockish(attr)):
+            return attr
+        if isinstance(expr, ast.Name) and _lockish(expr.id):
+            return expr.id
+        # with self._lock_for(x): / acquire helpers — treat the callee name
+        if isinstance(expr, ast.Call):
+            name = _call_name(expr.func)
+            if name is not None and _lockish(name):
+                return name
+        return None
+
+    # -- structure ----------------------------------------------------------
+
+    def visit_ClassDef(self, node):     # nested class: its own analysis
+        return
+
+    def visit_With(self, node):
+        acquired = []
+        for item in node.items:
+            lk = self._lock_name(item.context_expr)
+            self.visit(item.context_expr)
+            if lk is not None:
+                self.locks_acquired.add(lk)
+                for outer in self._held:
+                    if outer != lk:
+                        self.lock_pairs.append((outer, lk, node))
+                self._held.append(lk)
+                acquired.append(lk)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self._held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def _test_checks(self, test: ast.AST) -> Set[str]:
+        """Attrs whose state the test examines (membership, truthiness,
+        .get/keys/...) — candidates for check-then-act."""
+        out: Set[str] = set()
+        for sub in ast.walk(test):
+            attr = _self_attr(sub)
+            if attr is not None:
+                out.add(attr)
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in _CHECK_READS):
+                base = _self_attr(sub.func.value)
+                if base is not None:
+                    out.add(base)
+        return out
+
+    def _visit_branching(self, node):
+        self.visit(node.test)
+        checked = (self._test_checks(node.test)
+                   if not self._guarded() else set())
+        self._checked.append(checked)
+        for stmt in node.body:
+            self.visit(stmt)
+        self._checked.pop()
+        for stmt in getattr(node, "orelse", []):
+            self.visit(stmt)
+
+    visit_If = _visit_branching
+    visit_While = _visit_branching
+
+    # -- accesses -----------------------------------------------------------
+
+    def _write_target(self, tgt: ast.AST, kind: str, node: ast.AST) -> None:
+        attr = _self_attr(tgt)
+        if attr is not None:
+            self._record(attr, kind, node)
+            return
+        if isinstance(tgt, ast.Subscript):
+            base = _self_attr(tgt.value)
+            if base is not None:
+                self._record(base, "sub" if kind == "write" else kind, node)
+            else:
+                self.visit(tgt.value)
+            self.visit(tgt.slice)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._write_target(el, kind, node)
+        else:
+            self.visit(tgt)
+
+    def visit_Assign(self, node):
+        for tgt in node.targets:
+            self._write_target(tgt, "write", node)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._write_target(node.target, "write", node)
+            self.visit(node.value)
+
+    def visit_AugAssign(self, node):
+        self._write_target(node.target, "aug", node)
+        self.visit(node.value)
+
+    def visit_Delete(self, node):
+        for tgt in node.targets:
+            self._write_target(tgt, "sub" if isinstance(tgt, ast.Subscript)
+                               else "write", node)
+
+    def visit_Attribute(self, node):
+        attr = _self_attr(node)
+        if attr is not None and isinstance(node.ctx, ast.Load):
+            self._record(attr, "read", node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        # self.m(...) intra-class call edges
+        f = node.func
+        callee = _self_attr(f)
+        if callee is not None and isinstance(f, ast.Attribute):
+            self.calls_self.add(callee)
+            if self._held:
+                for lk in self._held:
+                    self.calls_under_lock.append((lk, callee, node))
+        # self.<attr>.<mutator>(...) container mutation
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+            base = _self_attr(f.value)
+            if base is not None:
+                self._record(base, "mut", node)
+        # threading.Thread(target=...) creation
+        name = _call_name(f)
+        if name == "Thread":
+            self._scan_thread_ctor(node)
+        elif name == "register" and isinstance(f, ast.Attribute) \
+                and isinstance(f.value, ast.Name) and f.value.id == "atexit":
+            for arg in node.args[:1]:
+                tgt = _self_attr(arg)
+                if tgt is not None:
+                    self.atexit_targets.add(tgt)
+        self.generic_visit(node)
+
+    def _scan_thread_ctor(self, node: ast.Call) -> None:
+        target_method = None
+        daemon = False
+        for kw in node.keywords:
+            if kw.arg == "target":
+                tm = _self_attr(kw.value)
+                if tm is not None:
+                    target_method = tm
+                elif isinstance(kw.value, ast.Name):
+                    target_method = kw.value.id    # nested fn in this method
+            elif kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                daemon = bool(kw.value.value)
+        if target_method is not None:
+            self.thread_targets.add(target_method)
+        self.threads.append({"node": node, "daemon": daemon,
+                             "stored_attr": None, "stored_name": None})
+
+    # functions nested in the method are walked and attributed to the
+    # method (their bodies run on whatever thread invokes the closure —
+    # often another one). Guard state does NOT carry in: a closure DEFINED
+    # under `with self._lock` executes later, when the definer's lock is
+    # long released — treating its writes as guarded would silently pass
+    # exactly the deferred-callback races this checker exists for.
+    def _visit_nested_fn(self, body_stmts):
+        held, checked = self._held, self._checked
+        self._held, self._checked = [], []
+        try:
+            for stmt in body_stmts:
+                self.visit(stmt)
+        finally:
+            self._held, self._checked = held, checked
+
+    def visit_FunctionDef(self, node):
+        self._visit_nested_fn(node.body)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self._visit_nested_fn([node.body])  # a lambda body is one expression
+
+
+def _collect_lock_attrs(cls: ast.ClassDef) -> Tuple[Set[str], Set[str]]:
+    """(lock attrs, all sync-primitive attrs) assigned anywhere in the
+    class as ``self.x = threading.Lock()`` etc."""
+    locks: Set[str] = set()
+    sync: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            value = node.value
+            kind = _is_sync_factory_call(value) if value is not None else None
+            if kind is None:
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for tgt in targets:
+                attr = _self_attr(tgt)
+                if attr is not None:
+                    sync.add(attr)
+                    if kind in _LOCK_FACTORIES:
+                        locks.add(attr)
+    return locks, sync
+
+
+def _closure(edges: Dict[str, Set[str]], roots: Set[str]) -> Set[str]:
+    seen: Set[str] = set()
+    stack = [r for r in roots]
+    while stack:
+        m = stack.pop()
+        if m in seen:
+            continue
+        seen.add(m)
+        stack.extend(edges.get(m, ()))
+    return seen
+
+
+def _annotate_thread_storage(scan_by_method: Dict[str, _MethodScan],
+                             cls: ast.ClassDef) -> None:
+    """Mark each Thread() creation with where its object lands (self.attr,
+    a local name, or a container) and whether ``daemon`` is set later."""
+    for mname, scan in scan_by_method.items():
+        method = next((n for n in cls.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))
+                       and n.name == mname), None)
+        if method is None:
+            continue
+        ctor_ids = {id(t["node"]): t for t in scan.threads}
+        for node in ast.walk(method):
+            if isinstance(node, ast.Assign) and id(node.value) in ctor_ids:
+                rec = ctor_ids[id(node.value)]
+                for tgt in node.targets:
+                    attr = _self_attr(tgt)
+                    if attr is not None:
+                        rec["stored_attr"] = attr
+                    elif isinstance(tgt, ast.Name):
+                        rec["stored_name"] = tgt.id
+        # late daemon flags: self.<attr>.daemon = True / <name>.daemon = True
+        for node in ast.walk(method):
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.targets[0], ast.Attribute)
+                    and node.targets[0].attr == "daemon"
+                    and isinstance(node.value, ast.Constant)
+                    and node.value.value):
+                base = node.targets[0].value
+                battr = _self_attr(base)
+                for rec in scan.threads:
+                    if battr is not None and rec["stored_attr"] == battr:
+                        rec["daemon"] = True
+                    elif (isinstance(base, ast.Name)
+                          and rec["stored_name"] == base.id):
+                        rec["daemon"] = True
+
+
+def _join_calls(tree: ast.AST) -> List[Tuple[Optional[str], Optional[str]]]:
+    """(self-attr, local-name) bases of every ``<x>.join(...)`` call."""
+    out = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"):
+            base = node.func.value
+            # exclude str.join idiom: "sep".join(...) / "".join(...)
+            if isinstance(base, ast.Constant):
+                continue
+            out.append((_self_attr(base),
+                        base.id if isinstance(base, ast.Name) else None))
+    return out
+
+
+class _ClassReport:
+    """Everything the four checkers need about one class."""
+
+    def __init__(self, cls: ast.ClassDef):
+        self.cls = cls
+        self.name = cls.name
+        self.lock_attrs, self.sync_attrs = _collect_lock_attrs(cls)
+        self.methods: Dict[str, _MethodScan] = {}
+        for node in cls.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan = _MethodScan(self.lock_attrs, node.name)
+                for stmt in node.body:
+                    scan.visit(stmt)
+                self.methods[node.name] = scan
+        _annotate_thread_storage(self.methods, cls)
+        # thread roots: Thread targets + atexit hooks + HTTP handlers +
+        # __call__ (hook/callback protocol)
+        roots: Set[str] = set()
+        for mname, scan in self.methods.items():
+            roots |= scan.thread_targets & set(self.methods)
+            roots |= scan.atexit_targets & set(self.methods)
+            if scan.thread_targets - set(self.methods):
+                # a Thread targeting a function NESTED in this method: the
+                # closure's accesses are attributed to the method, so the
+                # method itself becomes a thread root — over-approximate
+                # (its non-closure accesses get the thread domain too),
+                # which errs toward flagging, never toward missing the
+                # closure-thread write
+                roots.add(mname)
+        roots |= _HTTP_HANDLERS & set(self.methods)
+        if "__call__" in self.methods:
+            roots.add("__call__")
+        self.roots = roots
+        edges = {m: s.calls_self & set(self.methods)
+                 for m, s in self.methods.items()}
+        self.reach_by_root = {r: _closure(edges, {r}) for r in roots}
+        thread_methods = set().union(*self.reach_by_root.values()) \
+            if self.reach_by_root else set()
+        main_entries = set(self.methods) - thread_methods
+        self.main_methods = _closure(edges, main_entries)
+        self.domains: Dict[str, Set[str]] = {}
+        for m in self.methods:
+            d = {f"thread:{r}" for r, reach in self.reach_by_root.items()
+                 if m in reach}
+            if m in self.main_methods:
+                d.add("main")
+            self.domains[m] = d or {"main"}
+
+
+def _shared_attrs(report: _ClassReport) -> Dict[str, Set[str]]:
+    """attr -> union of access domains, for attrs shared across threads."""
+    by_attr: Dict[str, Set[str]] = {}
+    writes_outside_init: Set[str] = set()
+    public_thread_writes: Set[str] = set()
+    for mname, scan in report.methods.items():
+        if mname == "__init__":
+            continue
+        doms = report.domains[mname]
+        for acc in scan.accesses:
+            if acc.attr in report.sync_attrs:
+                continue
+            by_attr.setdefault(acc.attr, set()).update(doms)
+            if acc.writes:
+                writes_outside_init.add(acc.attr)
+                if (not acc.attr.startswith("_")
+                        and any(d != "main" for d in doms)):
+                    public_thread_writes.add(acc.attr)
+    return {attr: doms for attr, doms in by_attr.items()
+            if attr in writes_outside_init
+            and (len(doms) >= 2 or attr in public_thread_writes)}
+
+
+def _emit_shared_write_findings(report: _ClassReport, rel: str,
+                                findings: List[Finding]) -> None:
+    shared = _shared_attrs(report)
+    if not shared:
+        return
+    for mname, scan in report.methods.items():
+        if mname == "__init__":
+            continue
+        for acc in scan.accesses:
+            if not acc.writes or acc.guarded or acc.attr not in shared:
+                continue
+            doms = sorted(shared[acc.attr])
+            where = ", ".join(doms)
+            if acc.kind == "aug" or acc.checked_first:
+                what = ("read-modify-write" if acc.kind == "aug"
+                        else "check-then-act mutation")
+                findings.append(Finding(
+                    "JL302", "unsynchronized-rmw", rel,
+                    getattr(acc.node, "lineno", 0), mname,
+                    f"{what} on shared self.{acc.attr} "
+                    f"({report.name}; accessed from {where}) without a "
+                    f"lock — interleaved threads lose updates (or race the "
+                    f"test against a concurrent mutation); guard both "
+                    f"sides with one class lock"))
+            else:
+                verb = {"write": "written", "sub": "item-assigned",
+                        "mut": "mutated"}[acc.kind]
+                findings.append(Finding(
+                    "JL301", "unguarded-shared-write", rel,
+                    getattr(acc.node, "lineno", 0), mname,
+                    f"shared self.{acc.attr} {verb} without a lock "
+                    f"({report.name}; accessed from {where}) — guard it "
+                    f"with the class lock, or make it a threading.Event/"
+                    f"queue if it is a signal"))
+
+
+def _emit_lock_order_findings(report: _ClassReport, rel: str,
+                              findings: List[Finding]) -> None:
+    # transitive lock sets: locks a method acquires itself or via callees
+    edges = {m: s.calls_self & set(report.methods)
+             for m, s in report.methods.items()}
+    # every acquisition counts, including sole (non-nested) ones: a caller
+    # holding A that calls a method which takes B establishes A->B even
+    # though neither method nests two withs itself
+    direct = {m: set(s.locks_acquired) for m, s in report.methods.items()}
+    acquires: Dict[str, Set[str]] = {}
+
+    def acq_closure(m: str, seen: Set[str]) -> Set[str]:
+        if m in acquires:
+            return acquires[m]
+        if m in seen:
+            return direct.get(m, set())
+        seen.add(m)
+        out = set(direct.get(m, set()))
+        for callee in edges.get(m, ()):  # locks taken by callees too
+            out |= acq_closure(callee, seen)
+        acquires[m] = out
+        return out
+
+    for m in report.methods:
+        acq_closure(m, set())
+
+    pairs: Dict[Tuple[str, str], Tuple[str, ast.AST]] = {}
+    for m, s in report.methods.items():
+        for (outer, inner, node) in s.lock_pairs:
+            pairs.setdefault((outer, inner), (m, node))
+        for (held, callee, node) in s.calls_under_lock:
+            for inner in acquires.get(callee, ()):  # call takes more locks
+                if inner != held:
+                    pairs.setdefault((held, inner), (m, node))
+    reported = set()
+    for (a, b), (m, node) in sorted(pairs.items(),
+                                    key=lambda kv: (kv[1][0], kv[0])):
+        if (b, a) in pairs and frozenset((a, b)) not in reported:
+            reported.add(frozenset((a, b)))
+            m2, _n2 = pairs[(b, a)]
+            findings.append(Finding(
+                "JL303", "lock-order-inversion", rel,
+                getattr(node, "lineno", 0), m,
+                f"{report.name}.{m}() acquires {a} then {b}, but "
+                f"{report.name}.{m2}() acquires {b} then {a} — ABBA "
+                f"deadlock the moment both run concurrently; pick ONE "
+                f"order (document it on the lock attributes) or collapse "
+                f"to a single lock"))
+
+
+def _emit_lifecycle_findings(report: _ClassReport, rel: str,
+                             findings: List[Finding]) -> None:
+    joins = _join_calls(report.cls)
+    join_attrs = {a for a, _n in joins if a is not None}
+    join_names = {n for _a, n in joins if n is not None}
+    any_join = bool(joins)
+    for mname, scan in report.methods.items():
+        method_joins = {n for _a, n in _join_calls_method(report, mname)}
+        for rec in scan.threads:
+            if rec["daemon"]:
+                continue
+            attr, local = rec["stored_attr"], rec["stored_name"]
+            if attr is not None and attr in join_attrs:
+                continue
+            if local is not None and (local in join_names
+                                      or local in method_joins):
+                continue
+            if attr is None and local is None and any_join:
+                continue      # escaped into a container; class does join
+            where = (f"self.{attr}" if attr is not None
+                     else (local or "an unbound Thread"))
+            findings.append(Finding(
+                "JL304", "thread-lifecycle", rel,
+                getattr(rec["node"], "lineno", 0), mname,
+                f"non-daemon thread ({where}) started in "
+                f"{report.name}.{mname}() is never joined on any close "
+                f"path — interpreter exit blocks on it forever; pass "
+                f"daemon=True (and join in close()) or join it where the "
+                f"object shuts down"))
+
+
+def _join_calls_method(report: _ClassReport, mname: str):
+    method = next((n for n in report.cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                   and n.name == mname), None)
+    return _join_calls(method) if method is not None else []
+
+
+def _module_function_lifecycle(mod: ast.AST, rel: str,
+                               findings: List[Finding]) -> None:
+    """JL304 for threads created in module-level functions (no class)."""
+    for node in mod.body if isinstance(mod, ast.Module) else []:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        scan = _MethodScan(set(), node.name)
+        for stmt in node.body:
+            scan.visit(stmt)
+        joins = _join_calls(node)
+        join_names = {n for _a, n in joins if n is not None}
+        for rec in scan.threads:
+            if rec["daemon"]:
+                continue
+            local = rec["stored_name"]
+            if local is not None and local in join_names:
+                continue
+            if local is None and joins:
+                continue
+            findings.append(Finding(
+                "JL304", "thread-lifecycle", rel,
+                getattr(rec["node"], "lineno", 0), node.name,
+                f"non-daemon thread ({local or 'unbound'}) started in "
+                f"{node.name}() is never joined — interpreter exit blocks "
+                f"on it; pass daemon=True or join it before returning"))
+
+
+def check_concurrency(mod: ast.AST, rel: str, src: str) -> List[Finding]:
+    """All four JL3xx codes over one host-plane module."""
+    if not rel.startswith(HOST_TREES):
+        return []
+    findings: List[Finding] = []
+    # classes at any nesting level (handler classes defined inside methods
+    # — the exporter's BaseHTTPRequestHandler subclass — included)
+    for node in ast.walk(mod):
+        if isinstance(node, ast.ClassDef):
+            report = _ClassReport(node)
+            _emit_shared_write_findings(report, rel, findings)
+            _emit_lock_order_findings(report, rel, findings)
+            _emit_lifecycle_findings(report, rel, findings)
+    _module_function_lifecycle(mod, rel, findings)
+    return findings
+
+
+THREAD_CHECKERS = [check_concurrency]

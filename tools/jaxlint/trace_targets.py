@@ -312,3 +312,44 @@ TARGETS: Dict[str, Callable[[], Tuple[Callable, tuple]]] = {
     "reshard_factor_ring": _reshard("ring"),
     "serve_topk_mf_rebalanced": _serve_topk_rebalanced,
 }
+
+
+# -- gang-mode targets (ISSUE 13 tentpole, the carried "jaxlint multi-host
+# budgets" ROADMAP item) ----------------------------------------------------
+#
+# A gang-mode target is a `dryrun_multichip` step program traced on the
+# SAME 8-worker tracing mesh but with a declared multi-process topology:
+# ``processes`` hosts x ``devices_per_process`` local devices, the workers
+# axis laid out contiguously per process (exactly how
+# ``parallel.distributed.initialize`` + ``make_mesh`` place a real gang —
+# mp_smoke's 2x4 layout). The program is SPMD, so every process traces the
+# SAME jaxpr; what differs per process is the SHARD it owns and which hops
+# cross the data-center network instead of on-pod ICI. The manifest row
+# therefore pins, besides counts/bytes:
+#
+# * ``per_process_shard_shapes`` — the per-process block of every program
+#   input (a replicated dim stays global; a workers-sharded dim is the
+#   global extent over ``processes``). A drifted shard shape means the
+#   partitioner changed what each HOST holds — a resharding contract break
+#   (arXiv:2112.01075 treats the redistribution layout as first-class),
+#   JL201.
+# * ``bytes_by_link`` — ``bytes_by_kind`` split DCN vs ICI with the
+#   ring-edge/peer model in checkers_jaxpr.split_bytes_by_link, gated on
+#   ``mesh.axis_link_class(WORKERS)`` (gang launchers hint the workers
+#   axis "dcn" at bootstrap; the DrJAX-style multi-mesh programs of
+#   arXiv:2403.07128 make that DCN/ICI split first-class). Growing DCN
+#   bytes at fixed counts is exactly the cross-pod regression the
+#   single-process rows cannot see, JL203.
+#
+# The workloads are the dryrun_multichip gang's own exercises (mp_smoke):
+# K-means over both parallelism families, SGD-MF, and LDA.
+
+GANG_PROCESSES = 2
+GANG_DEVICES_PER_PROCESS = 4     # 2 x 4 = NUM_WORKERS, mp_smoke's layout
+
+GANG_TARGETS: Dict[str, Callable[[], Tuple[Callable, tuple]]] = {
+    "gang2x4_kmeans_regroupallgather": _kmeans("regroupallgather"),
+    "gang2x4_kmeans_rotation": _kmeans("rotation"),
+    "gang2x4_sgd_mf_dense": _sgd_mf(),
+    "gang2x4_lda_cgs": _lda(),
+}
