@@ -994,6 +994,32 @@ def tpu_serving(small=False):
     return row
 
 
+def tpu_serving_fleet(small=False):
+    """Fleet-operations rows (ISSUE 14 acceptance): the recovery-blip run
+    (a SEPARATE-PROCESS serving gang under retrying load absorbs a
+    scripted ``kill@request=N`` — spare restored through the on-device
+    reshard engine, zero failed requests, the recovery-window p99 blip
+    measured against steady state), the live-refresh run (factor epochs
+    pushed mid-traffic through the versioned snapshot swap — torn reads
+    asserted zero by checking every reply against ITS version's
+    reference), and the hot-key run (Zipfian load, router reply cache off
+    vs on — hit rate, lookup skew, and the hot subset's tail). See
+    harp_tpu/benchmark/serving_fleet.py for the scenario scripts."""
+    from harp_tpu.benchmark import serving_fleet
+    from harp_tpu.session import HarpSession
+
+    sess = HarpSession()
+    return {
+        "recovery": serving_fleet.measure_recovery(
+            requests_per_client=60 if small else 120),
+        "refresh": serving_fleet.measure_refresh(
+            sess, requests_per_client=100 if small else 200),
+        "hotkey": serving_fleet.measure_hotkey(
+            sess, requests_per_client=150 if small else 400,
+            zipf_alpha=1.2),
+    }
+
+
 def tpu_reshard(small=False):
     """On-device reshard rows (ISSUE 11): seconds + bytes moved for a
     world-size-changing factor-table redistribution vs the PR 8 host
@@ -1536,6 +1562,32 @@ def main():
                                                   {}).get("p50_ms"),
                 "serving_span_p50_ratio": rec.get("p50_ratio"),
                 "serving_span_mean_ratio": rec.get("mean_ratio")})
+        # r15 fleet rows (ISSUE 14): recovery blip (separate-process gang,
+        # scripted kill, reshard-engine spare restore), live refresh under
+        # load (versioned swap, torn reads asserted zero), hot-key cache
+        # vs the unmitigated Zipfian baseline
+        begin("serving_fleet")
+        try:
+            frow = tpu_serving_fleet(small)
+        except Exception as e:     # noqa: BLE001 — bench must not die here
+            frow = {"error": str(e)[:200]}
+        detail["serving_fleet"] = frow
+        if isinstance(frow, dict) and "recovery" in frow:
+            rec_row = frow["recovery"]
+            ref_row = frow.get("refresh", {})
+            hot_row = frow.get("hotkey", {})
+            compact.update({
+                "fleet_recovery_errors": rec_row.get("errors"),
+                "fleet_recovery_s": rec_row.get("observed_recovery_s"),
+                "fleet_recovery_p99_blip_ms":
+                    (rec_row.get("recovery_window") or {}).get("p99_ms"),
+                "fleet_refresh_torn_reads": ref_row.get("torn_reads"),
+                "fleet_refresh_errors": ref_row.get("errors"),
+                "fleet_hotkey_hit_rate":
+                    ((hot_row.get("cached") or {}).get("cache")
+                     or {}).get("hit_rate"),
+                "fleet_hotkey_hot_p99_speedup":
+                    hot_row.get("hot_p99_speedup")})
 
     if want("reshard"):
         begin("reshard")

@@ -12,6 +12,15 @@ Grammar: comma-separated specs, each ``<kind>@key=value[:key=value...]``.
 
 kinds
     ``crash``        ``os._exit(FAULT_CRASH_EXIT)`` — a hard member death.
+    ``kill``         the SERVING twin of ``crash``: fires at a request
+                     boundary (``request=N``) of a serving worker instead
+                     of a training iteration boundary. A subprocess worker
+                     dies ``os._exit(FAULT_CRASH_EXIT)``; an in-process
+                     :class:`~harp_tpu.serve.router.ServeWorker` dies
+                     abruptly through its ``die()`` hook (transport torn
+                     down mid-traffic, in-flight requests lost) — the
+                     serving-grade recovery scenario is scripted exactly
+                     like the training ones.
     ``vanish``       ``os._exit(FAULT_VANISH_EXIT)`` — the member stops
                      answering AND its host is to be treated as unreachable
                      (machine rebooted, NIC died, preempted VM). The
@@ -32,15 +41,30 @@ kinds
                      which must flag the rank while it stays alive.
 
 keys
-    ``epoch=N``   (required) fire at the first iteration boundary that
-                  reaches epoch N: ``crash``/``hang`` fire *before* epoch N
-                  runs (so the newest checkpoint is at most N-1);
-                  ``ckpt-corrupt`` fires once epoch N's checkpoint exists;
-                  ``slow`` fires at that boundary AND every later one
-                  (sustained — a one-boundary hiccup must not look like a
-                  straggler to the detector it exists to test).
-    ``rank=R``    only this gang member fires (HARP_PROCESS_ID; a process
-                  outside a gang is rank 0). Omitted = every rank. When the
+    ``epoch=N``   (required for training kinds) fire at the first iteration
+                  boundary that reaches epoch N: ``crash``/``hang`` fire
+                  *before* epoch N runs (so the newest checkpoint is at
+                  most N-1); ``ckpt-corrupt`` fires once epoch N's
+                  checkpoint exists; ``slow`` fires at that boundary AND
+                  every later one (sustained — a one-boundary hiccup must
+                  not look like a straggler to the detector it exists to
+                  test).
+    ``request=N`` the SERVING trigger point (ISSUE 14): fire at the Nth
+                  request this serving worker receives (1-based,
+                  :func:`serve_fire` — the router calls it per received
+                  request). ``kill``/``vanish`` die at that request;
+                  ``slow`` drags EVERY dispatch from request N on
+                  (sustained, same reasoning as the epoch flavor). A spec
+                  carries ``epoch=`` or ``request=``, never both —
+                  training boundaries and serving request streams are
+                  different clocks.
+    ``rank=R``    only this gang member fires (HARP_PROCESS_ID for the
+                  training boundary hook; the SERVING rank the router
+                  passes to :func:`serve_fire` for request faults — an
+                  in-process serving gang holds several serving ranks in
+                  one OS process, so the env var cannot name them). A
+                  process outside a gang is rank 0. Omitted = every rank.
+                  When the
                   world size is known (HARP_NUM_PROCESSES, or an explicit
                   ``world_size=`` to :func:`parse_faults`), an out-of-range
                   R is rejected LOUDLY at parse time — a fault that could
@@ -71,17 +95,21 @@ FAULT_VANISH_EXIT = 86     # scripted "host gone": member exits and the
 #                            supervisor must treat its HOST as unreachable
 #                            (re-place onto a spare / shrink, never relaunch
 #                            onto it)
-_KINDS = ("crash", "vanish", "hang", "ckpt-corrupt", "slow")
+_KINDS = ("crash", "kill", "vanish", "hang", "ckpt-corrupt", "slow")
+# kinds that may ride the serving request clock (request=N); kill is
+# serving-ONLY — the training twin is crash@epoch=
+_SERVE_KINDS = ("kill", "vanish", "slow")
 SLOW_DEFAULT_MS = 100
 
 
 @dataclasses.dataclass(frozen=True)
 class FaultSpec:
     kind: str
-    epoch: int
+    epoch: Optional[int] = None     # training trigger (iteration boundary)
     rank: Optional[int] = None      # None = every rank
     attempt: int = 0
     ms: int = SLOW_DEFAULT_MS       # slow only: per-boundary sleep
+    request: Optional[int] = None   # serving trigger (Nth received request)
 
 
 def parse_faults(text: str,
@@ -112,16 +140,29 @@ def parse_faults(text: str,
         kv = {}
         for item in filter(None, argstr.split(":")):
             key, eq, val = item.partition("=")
-            if not eq or key not in ("epoch", "rank", "attempt", "ms"):
+            if not eq or key not in ("epoch", "rank", "attempt", "ms",
+                                     "request"):
                 raise ValueError(f"fault spec {part!r}: bad argument "
-                                 f"{item!r} (epoch=/rank=/attempt=/ms=)")
+                                 f"{item!r} "
+                                 f"(epoch=/request=/rank=/attempt=/ms=)")
             try:
                 kv[key] = int(val)
             except ValueError:
                 raise ValueError(f"fault spec {part!r}: {key}={val!r} is "
                                  f"not an integer") from None
-        if "epoch" not in kv:
-            raise ValueError(f"fault spec {part!r}: epoch= is required")
+        if ("epoch" in kv) == ("request" in kv):
+            raise ValueError(f"fault spec {part!r}: exactly one of epoch= "
+                             f"(training boundary) or request= (serving "
+                             f"request) is required")
+        if "request" in kv and kind not in _SERVE_KINDS:
+            raise ValueError(f"fault spec {part!r}: request= applies to "
+                             f"serving kinds {_SERVE_KINDS} only")
+        if kind == "kill" and "request" not in kv:
+            raise ValueError(f"fault spec {part!r}: kill is the serving "
+                             f"kind — it needs request=N (training deaths "
+                             f"are crash@epoch=)")
+        if "request" in kv and kv["request"] < 1:
+            raise ValueError(f"fault spec {part!r}: request= is 1-based")
         if "ms" in kv and kind != "slow":
             raise ValueError(f"fault spec {part!r}: ms= applies to slow "
                              f"faults only")
@@ -136,9 +177,10 @@ def parse_faults(text: str,
             raise ValueError(
                 f"fault spec {part!r}: rank={rank} is out of range for "
                 f"{bound} — this fault could never fire")
-        specs.append(FaultSpec(kind, kv["epoch"], kv.get("rank"),
+        specs.append(FaultSpec(kind, kv.get("epoch"), kv.get("rank"),
                                kv.get("attempt", 0),
-                               kv.get("ms", SLOW_DEFAULT_MS)))
+                               kv.get("ms", SLOW_DEFAULT_MS),
+                               kv.get("request")))
     return specs
 
 
@@ -188,6 +230,8 @@ def fire(next_epoch: int, checkpointer=None) -> None:
     # damage the checkpoint before the death ends the process
     order = sorted(specs, key=lambda s: s.kind != "ckpt-corrupt")
     for spec in order:
+        if spec.request is not None:
+            continue                 # serving specs ride serve_fire()
         # slow is SUSTAINED: it fires at every due boundary (never enters
         # _fired) — that is what makes it a straggler rather than a hiccup
         if (spec in _fired and spec.kind != "slow") \
@@ -201,6 +245,63 @@ def fire(next_epoch: int, checkpointer=None) -> None:
             continue
         _fired.add(spec)
         _execute(spec, checkpointer)
+
+
+def serve_fire(n_request: int, *, rank: int,
+               on_kill=None, on_vanish=None,
+               sleep=time.sleep) -> None:
+    """Request-boundary hook for the SERVING fault grammar (ISSUE 14): the
+    router calls this with its 1-based received-request counter and its
+    SERVING rank on every request frame. Executes any armed ``request=``
+    spec whose trigger point has been reached:
+
+    * ``kill``/``vanish`` fire at most once per (spec, rank):
+      ``on_kill``/``on_vanish`` when provided (the in-process gang's
+      abrupt ``ServeWorker.die()``), else ``os._exit`` with the matching
+      classification code — exactly the exits the fleet supervisor maps to
+      CRASH/VANISH.
+    * ``slow`` drags this worker ``ms`` per request from request N on
+      (sustained — the SLO watchdog must see a burn window, not a blip).
+
+    The hook sits on the request RECEIVE path, before batching — a death
+    lands mid-traffic with requests in flight, which is the scenario the
+    recovery machinery exists for."""
+    specs = _plan()
+    if not specs:
+        return
+    attempt = _attempt()
+    for spec in specs:
+        if spec.request is None or spec.attempt != attempt:
+            continue
+        if spec.rank is not None and spec.rank != rank:
+            continue
+        if n_request < spec.request:
+            continue
+        key = (spec, rank)
+        if spec.kind == "slow":
+            if key not in _printed:
+                _printed.add(key)
+                print(f"harp_tpu.faults: serving straggler slow@request="
+                      f"{spec.request} ms={spec.ms} (serve rank {rank}) — "
+                      f"every request from here",
+                      file=sys.stderr, flush=True)
+            sleep(spec.ms / 1000.0)
+            continue
+        if key in _fired:
+            continue
+        _fired.add(key)
+        print(f"harp_tpu.faults: firing {spec.kind}@request={spec.request} "
+              f"(serve rank {rank})", file=sys.stderr, flush=True)
+        if spec.kind == "kill":
+            if on_kill is not None:
+                on_kill()
+            else:
+                os._exit(FAULT_CRASH_EXIT)
+        elif spec.kind == "vanish":
+            if on_vanish is not None:
+                on_vanish()
+            else:
+                os._exit(FAULT_VANISH_EXIT)
 
 
 def _execute(spec: FaultSpec, checkpointer) -> None:

@@ -325,6 +325,13 @@ class P2PTransport:
             except OSError:
                 pass
 
+    def peers(self) -> Dict[int, Tuple[str, int]]:
+        """Snapshot of the known peer address map — the serving fleet's
+        placement frames republish these so a re-routed client can dial
+        the survivors without a pre-shared map."""
+        with self._lock:
+            return dict(self._peers)
+
     def _resolve(self, dest: int) -> Tuple[str, int]:
         with self._lock:
             if dest in self._peers:

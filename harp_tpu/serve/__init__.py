@@ -49,25 +49,44 @@ the top-k endpoint histograms lookup skew per owning worker (the hot-key
 signal), and an optional per-worker SLO watchdog
 (``local_gang(slo_p99_s=...)``) turns sustained p99/error-budget burn
 into an xprof window + straggler snapshot + journaled incident.
+
+The FLEET layer (r15, ISSUE 14) makes the gang elastic and continuously
+redeployed: :mod:`~harp_tpu.serve.fleet` runs workers as separate
+processes (launched through the ``parallel/launch`` member-spawn path,
+file rendezvous, authenticated p2p), supervises them (a dead worker is
+classified crash/VANISH by exit code, its models re-routed by a versioned
+placement push, its KV shard restored onto a spare through the on-device
+reshard engine — ``TopKEndpoint.restore_shard``/``restore_full``), while
+clients ride ``RouterClient.request_retry`` (bounded retries with jitter,
+dead-rank fast-fail, placement re-sync). ``TopKEndpoint.push_epoch`` swaps
+in new factor epochs under live traffic (versioned, snapshot-consistent —
+every reply names the epoch that answered it), a shared
+:class:`~harp_tpu.serve.cache.TopKReplyCache` absorbs Zipfian hot keys at
+the router, and the whole recovery story is scripted through the serving
+fault grammar (``HARP_FAULT=kill|vanish|slow@request=N``).
 """
 
 from __future__ import annotations
 
 from harp_tpu.serve.batcher import MicroBatcher
+from harp_tpu.serve.cache import TopKReplyCache
 from harp_tpu.serve.endpoints import (ClassifyEndpoint, Endpoint,
                                       TopKEndpoint, classify_from_forest,
                                       classify_from_linear_svm,
                                       classify_from_multiclass_svm,
                                       classify_from_nn,
+                                      rebalance_from_incidents,
                                       rebalance_from_report)
 from harp_tpu.serve.protocol import (OP_CLASSIFY, OP_TOPK, ServeError,
+                                     make_placement, make_placement_get,
                                      make_reply, make_request)
 from harp_tpu.serve.router import RouterClient, ServeWorker, local_gang
 
 __all__ = [
     "ClassifyEndpoint", "Endpoint", "MicroBatcher", "OP_CLASSIFY", "OP_TOPK",
     "RouterClient", "ServeError", "ServeWorker", "TopKEndpoint",
-    "classify_from_forest", "classify_from_linear_svm",
+    "TopKReplyCache", "classify_from_forest", "classify_from_linear_svm",
     "classify_from_multiclass_svm", "classify_from_nn", "local_gang",
-    "make_reply", "make_request", "rebalance_from_report",
+    "make_placement", "make_placement_get", "make_reply", "make_request",
+    "rebalance_from_incidents", "rebalance_from_report",
 ]

@@ -187,7 +187,16 @@ class MicroBatcher:
             spans.stamp(m, spans.DISPATCH_START)
         try:
             batch = np.asarray([m["data"] for m in live])
-            results = self.endpoint.dispatch(batch)
+            # versioned dispatch when the endpoint offers it (the real
+            # Endpoint base does; bare test doubles need not): every row
+            # of this batch is answered by ONE factor epoch, and the
+            # replies say which — the live-refresh torn-read assertion
+            # rides on this
+            dv = getattr(self.endpoint, "dispatch_versioned", None)
+            if dv is not None:
+                results, version = dv(batch)
+            else:
+                results, version = self.endpoint.dispatch(batch), None
         except Exception as e:
             # a malformed query payload (wrong dtype/shape/range) can raise
             # anything from the stack below; the serving loop must reply
@@ -207,10 +216,24 @@ class MicroBatcher:
         self.metrics.gauge(f"serve.occupancy.{self.endpoint.name}",
                            n / bucket)
         self.metrics.count(f"serve.served.{self.endpoint.name}", n)
+        ver_kw = {} if version is None else {"version": version}
         for m, res in zip(live, results):
-            self._safe_reply(m, ok=True, result=res, batch=n, bucket=bucket)
+            self._safe_reply(m, ok=True, result=res, batch=n, bucket=bucket,
+                             **ver_kw)
 
     # ------------------------------------------------------------------ #
+
+    def kill(self, timeout: float = 5.0) -> None:
+        """ABRUPT stop (the chaos twin of drain_and_stop): refuse new
+        work AND drop everything pending unanswered — a killed worker's
+        accepted requests are lost in flight, their clients time out and
+        retry. The thread still joins so the corpse leaks nothing."""
+        with self._cv:
+            self._stopping = True
+            self._pending.clear()
+            self._cv.notify_all()
+        self._stopped.wait(timeout)
+        self._thread.join(timeout)
 
     def drain_and_stop(self, timeout: float = 30.0) -> None:
         """Refuse new work, serve everything already accepted, stop."""

@@ -1,0 +1,147 @@
+"""Hot-key reply cache — the router-side Zipfian mitigation (ISSUE 14).
+
+Million-user traffic is Zipfian and ``owner = id mod W`` concentrates the
+hot ids' lookups on a handful of workers — the ``TopKEndpoint.lookup_skew``
+histogram (PR 12) measures exactly that melt. This module is the remedy the
+ROADMAP names: cache recent top-k REPLIES at the router, so a hot user's
+repeat lookups stop paying the route + dispatch entirely (and, when the
+front worker is not the owner, the forward hop too).
+
+Correctness under live refresh: every entry is keyed by the endpoint's
+factor-epoch ``version`` (the ``push_epoch`` counter). A refresh therefore
+invalidates the whole cached generation implicitly — a stale epoch's reply
+can never be served after the swap, without any flush coordination. Entries
+additionally expire after ``ttl_s`` and the store is LRU-bounded at
+``capacity`` (hot keys stay, the long tail churns through).
+
+Thread model: one lock around the OrderedDict — ``get``/``put`` are called
+from the worker's receive thread (hit check) and every batcher thread
+(fill), so the JL3xx concurrency lint applies. Hit/miss tallies land in the
+shared metrics registry (``serve.cache_hits.<name>`` /
+``serve.cache_misses.<name>``) plus a local exact counter pair for the
+bench's hit-rate row.
+
+A cache instance may be SHARED across the workers of an in-process gang:
+then the owner's dispatch fill is visible to every front worker, which is
+the "replicate the hot keys" half of the ROADMAP item — the hot rows
+effectively exist on all routers at once, consistency guaranteed by the
+version key rather than by invalidation traffic.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Optional
+
+DEFAULT_CAPACITY = 4096
+DEFAULT_TTL_S = 30.0
+
+
+class TopKReplyCache:
+    """Versioned, TTL'd, LRU-bounded (model, id, epoch) -> reply store."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 ttl_s: float = DEFAULT_TTL_S, *, metrics=None,
+                 name: str = "topk"):
+        if metrics is None:
+            from harp_tpu.utils.metrics import DEFAULT as metrics
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        self.capacity = int(capacity)
+        self.ttl_s = float(ttl_s)
+        self.metrics = metrics
+        self.name = name
+        self._lock = threading.Lock()
+        # key -> (expiry_ts, result); move_to_end on hit = LRU order
+        self._store: "collections.OrderedDict" = collections.OrderedDict()
+        # per-model newest epoch seen by any fill: what a NON-owner
+        # router (which cannot read the endpoint's version) keys its
+        # lookups on — the cross-router half of the hot-key replication
+        self._latest: dict = {}
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _key(model: str, data: Any, version: Optional[int]):
+        """None = uncacheable (a non-scalar payload, or an unversioned
+        endpoint — caching without a version key would serve stale epochs
+        after a refresh)."""
+        if version is None:
+            return None
+        try:
+            return (model, int(data), int(version))
+        except (TypeError, ValueError):
+            return None
+
+    def get(self, model: str, data: Any, version: Optional[int],
+            now: Optional[float] = None):
+        """The cached reply result, or None. Expired/stale entries are
+        evicted on the way out; every call tallies hit or miss."""
+        key = self._key(model, data, version)
+        if key is None:
+            return None
+        now = time.time() if now is None else now
+        with self._lock:
+            entry = self._store.get(key)
+            if entry is not None and entry[0] > now:
+                self._store.move_to_end(key)
+                self.hits += 1
+                hit = entry[1]
+            else:
+                if entry is not None:
+                    del self._store[key]
+                self.misses += 1
+                hit = None
+        if hit is not None:
+            self.metrics.count(f"serve.cache_hits.{self.name}")
+        else:
+            self.metrics.count(f"serve.cache_misses.{self.name}")
+        return hit
+
+    def get_latest(self, model: str, data: Any,
+                   now: Optional[float] = None):
+        """Hit against the newest epoch any fill has seen for ``model`` —
+        the NON-owner router's lookup (it holds no endpoint to read a
+        version from). Returns ``(result, version)`` or None. Same
+        freshness guarantee as an owner-side hit modulo swap timing: the
+        entry was valid under that epoch, TTL bounds its age, and a
+        fill at a newer epoch retires this key for every router at
+        once."""
+        with self._lock:
+            version = self._latest.get(model)
+        if version is None:
+            return None
+        hit = self.get(model, data, version, now=now)
+        return None if hit is None else (hit, version)
+
+    def put(self, model: str, data: Any, version: Optional[int],
+            result, now: Optional[float] = None) -> bool:
+        key = self._key(model, data, version)
+        if key is None or result is None:
+            return False
+        now = time.time() if now is None else now
+        with self._lock:
+            self._store[key] = (now + self.ttl_s, result)
+            self._store.move_to_end(key)
+            prev = self._latest.get(model)
+            if prev is None or key[2] > prev:
+                self._latest[model] = key[2]
+            while len(self._store) > self.capacity:
+                self._store.popitem(last=False)
+        return True
+
+    def stats(self) -> dict:
+        """Exact hit/miss tallies + occupancy — the bench's hit-rate row."""
+        with self._lock:
+            hits, misses, size = self.hits, self.misses, len(self._store)
+        total = hits + misses
+        return {"hits": hits, "misses": misses, "size": size,
+                "capacity": self.capacity, "ttl_s": self.ttl_s,
+                "hit_rate": (hits / total) if total else 0.0}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._store.clear()
+            self._latest.clear()

@@ -76,6 +76,13 @@ class Endpoint:
         # and the prepared() snapshot atomic, so a dispatch never pairs the
         # old program with the new state (or vice versa)
         self._resident_lock = threading.Lock()
+        # the LIVE-REFRESH epoch (ISSUE 14): None = this endpoint is
+        # UNVERSIONED (classify — its replies carry version None, per the
+        # protocol contract); TopKEndpoint sets 0 and push_epoch bumps it
+        # under the resident lock, snapshotted with (fn, state) in
+        # prepared_versioned — every row of one dispatch is answered by
+        # exactly ONE factor epoch, and the reply carries which
+        self.version: Optional[int] = None
 
     @property
     def max_batch(self) -> int:
@@ -121,23 +128,36 @@ class Endpoint:
     def _place_query(self, batch: np.ndarray, bucket: int):
         raise NotImplementedError
 
-    def prepared(self, batch) -> Tuple[object, tuple, int, int]:
-        """(compiled fn, full arg tuple, n, bucket) for a request batch —
-        the dispatch surface, also what the jaxlint trace target traces.
-        The (fn, state) pair is snapshotted under the resident lock so a
-        concurrent rebalance/restore can never hand a dispatch the old
-        program with the new state."""
+    def prepared_versioned(self, batch
+                           ) -> Tuple[object, tuple, int, int, int]:
+        """(compiled fn, full arg tuple, n, bucket, version) for a request
+        batch — the dispatch surface, also what the jaxlint trace target
+        traces. The (fn, state, version) triple is snapshotted under the
+        resident lock so a concurrent rebalance/restore/push_epoch can
+        never hand a dispatch the old program with the new state — or a
+        version label that does not describe the factors it scored."""
         n = len(batch)
         bucket = self.bucket_for(n)
         with self._resident_lock:
             fn = self.compiled(bucket)
             state = self._state
-        return fn, state + (self._place_query(batch, bucket),), n, bucket
+            version = self.version
+        return (fn, state + (self._place_query(batch, bucket),), n, bucket,
+                version)
+
+    def prepared(self, batch) -> Tuple[object, tuple, int, int]:
+        """The historical 4-tuple surface (fn, args, n, bucket)."""
+        return self.prepared_versioned(batch)[:4]
+
+    def dispatch_versioned(self, batch) -> Tuple[List, int]:
+        """Serve one coalesced batch; returns (one result per input row,
+        the factor-epoch version that answered ALL of them)."""
+        fn, args, n, _bucket, version = self.prepared_versioned(batch)
+        return self._unpack(fn(*args), n), version
 
     def dispatch(self, batch) -> List:
         """Serve one coalesced batch; returns one result per input row."""
-        fn, args, n, _bucket = self.prepared(batch)
-        return self._unpack(fn(*args), n)
+        return self.dispatch_versioned(batch)[0]
 
     def _unpack(self, out, n: int) -> List:
         raise NotImplementedError
@@ -350,8 +370,14 @@ class TopKEndpoint(Endpoint):
         self.num_items = items.shape[0]
         self._ids = ids.astype(np.int64)         # host index arrays only —
         self._owner = (ids % w).astype(np.int64)  # the shard map, not data
+        self.version = 0                # versioned endpoint: epoch 0
         self._owner_routed = False
         self._owner_map_host: Optional[np.ndarray] = None
+        # bumped by rebalance() (the only layout-changing move): push_epoch
+        # builds its replacement state OFF-lock against a layout snapshot
+        # and re-checks this generation before swapping, so a concurrent
+        # rebalance can never be overwritten with stale-layout arrays
+        self._layout_gen = 0
         # per-owner lookup-skew histogram (host-side, pre-dispatch): the
         # measurement the ROADMAP hot-key item is built against — owner =
         # id mod W melts under Zipfian traffic, and this is where that
@@ -433,6 +459,102 @@ class TopKEndpoint(Endpoint):
             self._state = (keys, new_vals, counts, items) + self._state[4:]
         return len(mine)
 
+    def restore_full(self, user_factors, *,
+                     version: Optional[int] = None) -> int:
+        """Rebuild EVERY mesh rank's KV shard from the canonical factor
+        table — the spare-worker cold path (ISSUE 14): a replacement
+        serving process constructs this endpoint with placeholder factors
+        and re-materializes the whole store through the reshard engine's
+        chunk-bounded rounds, one :meth:`restore_shard` per mesh rank.
+        ``version`` stamps the restored state with the factor epoch the
+        canonical table represents (a spare must rejoin announcing the
+        SAME version the table it restored from carries, or the
+        per-dispatch version assertion would lie). Returns total rows
+        restored."""
+        restored = 0
+        for r in range(self.session.num_workers):
+            restored += self.restore_shard(r, user_factors)
+        if version is not None:
+            with self._resident_lock:
+                self.version = int(version)
+        return restored
+
+    def push_epoch(self, user_factors, item_factors=None, *,
+                   version: Optional[int] = None) -> int:
+        """Swap in a NEW factor epoch under live traffic — the continuous
+        train→serve deployment primitive (ISSUE 14 / ROADMAP "live model
+        refresh"): a concurrently-training gang pushes each SGD-MF/ALS
+        epoch here and the endpoint performs a versioned,
+        snapshot-consistent swap.
+
+        Protocol: the replacement device state is built and made FULLY
+        RESIDENT off-lock (the old version keeps serving the entire
+        while), then the (state, version) pair swaps atomically under the
+        resident lock — the same lock every dispatch snapshots (fn, state,
+        version) under, so no dispatch can ever score half-old/half-new
+        factors or mislabel which epoch answered it. The factor payload
+        rides the same scatter path the parameter-server push ops use.
+
+        Shapes are the endpoint's shapes (same ids, same rank, same item
+        count) — an epoch push is a refresh, not a reshape. Returns the
+        new version (``version`` overrides the monotonic default — the
+        training gang's own epoch number, so serving and training agree on
+        names)."""
+        import jax
+
+        sess = self.session
+        uf = np.asarray(user_factors, np.float32)
+        if uf.shape != (len(self._ids), self._dim):
+            raise ValueError(
+                f"epoch factors must be ({len(self._ids)}, {self._dim}) in "
+                f"the endpoint's id order; got {uf.shape}")
+        items_host = None
+        if item_factors is not None:
+            items_host = np.asarray(item_factors, np.float32)
+            if items_host.shape != (self.num_items, self._dim):
+                raise ValueError(
+                    f"epoch item factors must be ({self.num_items}, "
+                    f"{self._dim}); got {items_host.shape}")
+        while True:
+            with self._resident_lock:
+                gen = self._layout_gen
+                owner, slot, cap = self._owner, self._slot, self._cap
+                keys, counts_dev = self._state[0], self._state[2]
+                old_items = self._state[3]
+                tail = self._state[4:]
+            # build OFF-lock: dispatches keep serving the old epoch while
+            # the new one transfers; block_until_ready = fully resident
+            # before the swap is even attempted. Keys/counts/owner-map are
+            # layout, not payload — an epoch push reuses them as-is (the
+            # state args are never donated; only the query buffer is).
+            w = sess.num_workers
+            vals = np.zeros((w, cap, self._dim), np.float32)
+            vals[owner, slot] = uf
+            new_vals = sess.scatter(vals)
+            new_items = (old_items if items_host is None
+                         else sess.replicate_put(items_host))
+            jax.block_until_ready((new_vals, new_items))
+            with self._resident_lock:
+                if self._layout_gen != gen:
+                    continue    # a rebalance landed mid-build: rebuild
+                if version is not None and int(version) <= self.version:
+                    # epoch pushes must be MONOTONIC: two concurrent
+                    # pushes can finish out of order (the off-lock build
+                    # races), and an older epoch must never overwrite a
+                    # newer one — the loser's work is discarded here
+                    self.metrics.count(
+                        f"serve.refresh_superseded.{self.name}")
+                    return self.version
+                self._state = (keys, new_vals, counts_dev,
+                               new_items) + tail
+                self.version = (self.version + 1 if version is None
+                                else int(version))
+                new_version = self.version
+            self.metrics.count(f"serve.refreshes.{self.name}")
+            self.metrics.gauge(f"serve.version.{self.name}",
+                               float(new_version))
+            return new_version
+
     def rebalance(self, away_from) -> dict:
         """Move this endpoint's KV shards OFF the given rank(s) — the
         PR 7 straggler report's non-disruptive remedy: ids owned by a slow
@@ -499,6 +621,7 @@ class TopKEndpoint(Endpoint):
             self._state = (keys, new_vals, counts_dev, items,
                            sess.replicate_put(owner_map))
             self._owner_routed = True
+            self._layout_gen += 1
             self._fns.clear()    # owner-routed dispatch is a new program
         moved = int(plan.moved_rows)
         return {"moved": moved,
@@ -659,6 +782,31 @@ def rebalance_from_report(endpoint: TopKEndpoint, telemetry_dir: str,
 
     w = endpoint.session.num_workers
     ranks = straggler_ranks(telemetry_dir, world=w, max_age_s=max_age_s)
+    if not ranks or len(ranks) >= w:
+        return []
+    endpoint.rebalance(ranks)
+    return ranks
+
+
+def rebalance_from_incidents(endpoint: TopKEndpoint, telemetry_dir: str,
+                             max_age_s: Optional[float] = 600.0
+                             ) -> List[int]:
+    """Move a :class:`TopKEndpoint`'s shards off every rank the SLO
+    watchdog's INCIDENT STREAM names (``slo_incidents.jsonl`` — ISSUE 14:
+    the watchdog's journaled burn records carry the machine-readable
+    ``rank``/``p99_s``/``window_s`` fields this policy consumes, schema
+    pinned by :data:`harp_tpu.telemetry.watchdog.INCIDENT_REQUIRED_FIELDS`).
+    Where :func:`rebalance_from_report` reacts to the straggler DETECTOR,
+    this reacts to the SLO actually burning on a rank: sustained p99 or
+    error-budget burn journaled there slides that rank's shards to the
+    healthy workers while the gang keeps answering. Same guard rails:
+    stale incidents (older than ``max_age_s``) earn no migration, and an
+    incident set naming the whole gang is a measurement artifact, not a
+    placement fix. Returns the ranks moved away from."""
+    from harp_tpu.telemetry.watchdog import incident_ranks
+
+    w = endpoint.session.num_workers
+    ranks = incident_ranks(telemetry_dir, world=w, max_age_s=max_age_s)
     if not ranks or len(ranks) >= w:
         return []
     endpoint.rebalance(ranks)

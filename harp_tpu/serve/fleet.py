@@ -1,0 +1,677 @@
+"""Serving fleet supervision — elastic multi-process gangs, zero-downtime
+recovery, live refresh (ISSUE 14, the ROADMAP "production serving fleet").
+
+PR 10's serving gang was static: one ``local_gang`` process, placement
+frozen at startup, factors frozen at build. This module makes it a FLEET:
+
+* :class:`ProcessServeGang` — the multi-host shape: one
+  :mod:`~harp_tpu.serve.worker` subprocess per serving rank, launched
+  through the ``parallel/launch`` member-spawn path (localhost Popen / ssh
+  — the reference's Depl split), rendezvousing through a shared directory
+  of atomically-written address files, talking the same authenticated p2p
+  frames as the in-process gang. The controller monitors the members,
+  CLASSIFIES a death by exit code exactly like the training supervisor
+  (``FAULT_VANISH_EXIT`` → vanish: the host is retired and the spare pool
+  consulted; anything else non-zero → crash: respawn in place), re-routes
+  the placement map with a VERSIONED push, and brings the replacement up
+  through the spare path — zeroed stores re-materialized by the on-device
+  reshard engine (``TopKEndpoint.restore_full``) at the current factor
+  epoch — while the surviving ranks keep answering. The SLO watchdog's
+  incident stream (``slo_incidents.jsonl``, schema-pinned) is read at
+  every re-placement and attached to the journal record: the decision is
+  made WITH the burn evidence, not blind.
+* :class:`LocalFleet` — the same supervision over an in-process
+  ``local_gang`` (the tier-1/CI topology): an abruptly-died worker
+  (``ServeWorker.die()``, the chaos grammar's in-process ``kill``) is
+  replaced by a twin on a fresh port, its top-k shards re-materialized
+  from the canonical factor table through the reshard engine, and the new
+  placement applied to every survivor and adopted client directly.
+
+Recovery contract (both flavors): a dead worker costs — at most — the
+requests it was holding; those clients time out, fail fast on the dead
+rank, re-sync placement, and retry (``RouterClient.request_retry``). No
+surviving rank stops serving at any point, and after the placement push
+the gang is whole again. Every step is journaled (the supervisor-journal
+idiom) so the scripted chaos tests assert the story, not just the outcome.
+
+Model specs are DETERMINISTIC builders (seeded generators), so every
+process — initial worker, spare, refresh push — can regenerate any factor
+epoch's canonical table bit-identically without shipping arrays around:
+``{"kind": "topk", "num_users": U, "num_items": I, "rank": R, "k": K,
+"seed": S}`` or ``{"kind": "classify_nn", "dim": D, "classes": C,
+"layers": [H...], "seed": S}``. A real deployment would point these at a
+checkpoint path instead; the shape of the recovery machinery is identical.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import secrets as _secrets
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from harp_tpu.parallel import launch as launch_mod
+from harp_tpu.parallel.events import EventQueue
+from harp_tpu.parallel.faults import FAULT_VANISH_EXIT
+from harp_tpu.parallel.p2p import P2PTransport
+from harp_tpu.parallel.supervisor import WATCHDOG_EXIT, _Journal
+from harp_tpu.serve import protocol
+
+CONTROLLER_RANK = 9099        # far past any serving/client rank
+CLIENT_RANK_BASE = 1000
+DEFAULT_READY_TIMEOUT_S = 180.0
+
+
+# --------------------------------------------------------------------------- #
+# Deterministic model builders (the canonical-table source of truth)
+# --------------------------------------------------------------------------- #
+
+def topk_factors(mspec: dict, version: int
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Epoch ``version``'s canonical (user_factors, item_factors) for a
+    top-k model spec — seeded off (seed, version), so the training pusher,
+    the initial worker, and a restoring spare all regenerate the SAME
+    table for the same epoch, on any host."""
+    rng = np.random.default_rng([int(mspec.get("seed", 0)), int(version)])
+    uf = rng.normal(size=(int(mspec["num_users"]),
+                          int(mspec["rank"]))).astype(np.float32)
+    items = rng.normal(size=(int(mspec["num_items"]),
+                             int(mspec["rank"]))).astype(np.float32)
+    return uf, items
+
+
+def topk_reference(user_factors, item_factors, k: int):
+    """Canonical top-k answers for one factor table — the ONE reference
+    expression every fleet scenario (bench rows, chaos smoke) checks
+    replies against, so the torn-read and recovery-correctness
+    assertions can never drift from each other. Same tie convention as
+    the dispatch: stable argsort = lowest item id wins."""
+    scores = np.asarray(user_factors) @ np.asarray(item_factors).T
+    return {u: np.argsort(-scores[u], kind="stable")[:k].tolist()
+            for u in range(len(scores))}
+
+
+def build_endpoint(session, name: str, mspec: dict, *, version: int = 0,
+                   restore: bool = False):
+    """Construct one endpoint from its deterministic spec. ``restore``
+    takes the SPARE path for top-k models: the store is built ZEROED and
+    re-materialized through the on-device reshard engine
+    (:meth:`TopKEndpoint.restore_full`) at epoch ``version`` — the
+    serving-grade recovery primitive, exercised for real."""
+    kind = mspec.get("kind")
+    if kind == "topk":
+        from harp_tpu.serve.endpoints import TopKEndpoint
+
+        uf, items = topk_factors(mspec, version)
+        if restore:
+            ep = TopKEndpoint(session, name, np.zeros_like(uf), items,
+                              k=int(mspec.get("k", 10)))
+            ep.restore_full(uf, version=version)
+        else:
+            ep = TopKEndpoint(session, name, uf, items,
+                              k=int(mspec.get("k", 10)))
+            ep.version = int(version)
+        return ep
+    if kind == "classify_nn":
+        from harp_tpu.models import nn
+        from harp_tpu.serve.endpoints import classify_from_nn
+
+        layers = tuple(int(h) for h in mspec.get("layers", (32,)))
+        model = nn.MLPClassifier(session, nn.NNConfig(
+            layers=layers, num_classes=int(mspec["classes"])))
+        model.params = nn.init_params(
+            (int(mspec["dim"]),) + layers + (int(mspec["classes"]),),
+            seed=int(mspec.get("seed", 0)))
+        return classify_from_nn(session, model, name=name)
+    raise ValueError(f"unknown model-spec kind {kind!r} for {name!r}")
+
+
+# --------------------------------------------------------------------------- #
+# Rendezvous directory (the fleet's nodes-file analog)
+# --------------------------------------------------------------------------- #
+
+def read_rendezvous(rdv_dir: str
+                    ) -> List[Tuple[int, Tuple[str, int], int]]:
+    """Parse every worker address file — ``(rank, (host, port),
+    generation)``, newest generation per rank only. Torn/partial files are
+    skipped (writers use tmp+rename, but a reader must survive any seam)."""
+    best: Dict[int, Tuple[Tuple[str, int], int]] = {}
+    try:
+        names = os.listdir(rdv_dir)
+    except OSError:
+        return []
+    for fn in names:
+        if not (fn.startswith("w") and fn.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(rdv_dir, fn)) as f:
+                rec = json.load(f)
+            rank, gen = int(rec["rank"]), int(rec["generation"])
+            addr = (str(rec["host"]), int(rec["port"]))
+        except (OSError, ValueError, KeyError, TypeError):
+            continue
+        if rank not in best or best[rank][1] < gen:
+            best[rank] = (addr, gen)
+    return [(r, addr, gen) for r, (addr, gen) in sorted(best.items())]
+
+
+def classify_exit(rc: int) -> str:
+    """Exit code → failure class, the training supervisor's mapping
+    applied to serving members: the scripted ``vanish`` exit retires the
+    HOST (spare pool consulted), watchdog exits name a sick accelerator,
+    anything else non-zero is a crash respawned in place."""
+    if rc == 0:
+        return "clean"
+    if rc == FAULT_VANISH_EXIT:
+        return "vanish"
+    if rc == WATCHDOG_EXIT:
+        return "watchdog"
+    return "crash"
+
+
+def _fresh_incidents(telemetry_dir: Optional[str]) -> List[int]:
+    if not telemetry_dir:
+        return []
+    from harp_tpu.telemetry.watchdog import incident_ranks
+
+    return incident_ranks(telemetry_dir)
+
+
+# --------------------------------------------------------------------------- #
+# Multi-process serving gang
+# --------------------------------------------------------------------------- #
+
+class ProcessServeGang:
+    """Serving workers as separate OS processes + the supervising
+    controller (module docstring). Lifecycle::
+
+        gang = ProcessServeGang(models, placement, env_extra={...})
+        gang.start()                       # spawn + rendezvous + monitor
+        client = gang.make_client()
+        client.request_retry(OP_TOPK, "mf", 7)
+        gang.push_refresh(version=1)       # live factor refresh
+        gang.stop()                        # stop file -> drain -> exit 0
+
+    ``env_extra`` is where a scripted chaos scenario rides in
+    (``{"HARP_FAULT": "vanish@request=20:rank=1"}``): replacements spawn
+    with ``HARP_GANG_ATTEMPT=<generation>``, so a generation-0 fault is
+    DISARMED on the respawn — die once, recover, keep serving, exactly the
+    training supervisor's attempt-gating contract.
+    """
+
+    def __init__(self, model_specs: Dict[str, dict],
+                 placement: Dict[str, int], *,
+                 workdir: Optional[str] = None, mesh_workers: int = 2,
+                 max_wait_s: float = 0.002, cache: bool = False,
+                 slo_p99_s: Optional[float] = None,
+                 slo_kw: Optional[dict] = None,
+                 telemetry_dir: Optional[str] = None,
+                 env_extra: Optional[dict] = None,
+                 spare_hosts: Optional[List[str]] = None,
+                 recover_on_death: bool = True,
+                 python: Optional[str] = None, metrics=None):
+        if metrics is None:
+            from harp_tpu.utils.metrics import DEFAULT as metrics
+        self.metrics = metrics
+        self.model_specs = dict(model_specs)
+        self.placement = {str(m): int(r) for m, r in placement.items()}
+        self.world = len(set(self.placement.values()))
+        if set(self.placement.values()) != set(range(self.world)):
+            raise ValueError(
+                f"placement ranks must be exactly 0..{self.world - 1}, "
+                f"got {sorted(set(self.placement.values()))}")
+        self.workdir = workdir or tempfile.mkdtemp(prefix="harp-fleet-")
+        self.rdv_dir = os.path.join(self.workdir, "rendezvous")
+        os.makedirs(self.rdv_dir, exist_ok=True)
+        self.telemetry_dir = telemetry_dir
+        self.secret = _secrets.token_bytes(16)
+        self.env_extra = dict(env_extra or {})
+        self.spare_hosts = list(spare_hosts or [])
+        self.recover_on_death = recover_on_death
+        self.python = python or sys.executable
+        self.current_version = 0
+        self.placement_version = 0
+        # spawn members with the package's repo root as cwd: the
+        # controller may run from anywhere (launch._spawn inherits the
+        # caller's cwd otherwise, and `-m harp_tpu.serve.worker` must
+        # resolve), and the remote flavor cd's there over ssh
+        import harp_tpu
+
+        self._cwd = os.path.dirname(os.path.dirname(
+            os.path.abspath(harp_tpu.__file__)))
+        self.journal = _Journal(os.path.join(self.workdir,
+                                             "fleet_journal.jsonl"))
+        self.spec_path = os.path.join(self.workdir, "fleet_spec.json")
+        with open(self.spec_path, "w") as f:
+            json.dump({
+                "models": self.model_specs, "placement": self.placement,
+                "rendezvous_dir": self.rdv_dir,
+                "secret": self.secret.hex(),
+                "mesh_workers": int(mesh_workers),
+                "max_wait_s": float(max_wait_s), "cache": bool(cache),
+                "slo_p99_s": slo_p99_s, "slo_kw": slo_kw or {},
+                "telemetry_dir": telemetry_dir,
+            }, f, indent=1)
+        # mutable fleet state, guarded by _lock: the monitor thread and
+        # the caller's thread both touch it
+        self._lock = threading.Lock()
+        self._procs: Dict[int, subprocess.Popen] = {}
+        self._sinks: Dict[int, List[str]] = {}
+        self._drains: Dict[int, threading.Thread] = {}
+        self._hosts: Dict[int, str] = {}
+        self._generations: Dict[int, int] = {}
+        self.worker_addrs: Dict[int, Tuple[str, int]] = {}
+        self._clients: Dict[int, Tuple[str, int]] = {}
+        self._client_objs: list = []
+        self._client_ranks = itertools.count(CLIENT_RANK_BASE)
+        self._stopping = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self._queue = EventQueue()
+        self._transport = P2PTransport(self._queue, rank=CONTROLLER_RANK,
+                                       peers={}, secret=self.secret)
+
+    def _journal(self, record: dict) -> None:
+        # the journal is appended from the monitor thread AND the caller's
+        # thread (start/stop/push_refresh) — serialize under the class lock
+        with self._lock:
+            self.journal.append(record)
+
+    # -- spawn/rendezvous ---------------------------------------------------
+
+    def _spawn(self, rank: int, generation: int, *, restore: bool,
+               host: str = "localhost") -> None:
+        cmd = [self.python, "-m", "harp_tpu.serve.worker",
+               "--spec", self.spec_path, "--rank", str(rank),
+               "--generation", str(generation),
+               "--version", str(self.current_version)]
+        if restore:
+            cmd.append("--restore")
+        env = {"HARP_PROCESS_ID": str(rank),
+               "HARP_NUM_PROCESSES": str(self.world),
+               "HARP_GANG_ATTEMPT": str(generation),
+               "JAX_PLATFORMS": "cpu",
+               **self.env_extra}
+        # the launch module's member-spawn path: localhost Popen or ssh,
+        # stdout drained on a thread so a chatty worker can never stall
+        proc = launch_mod._spawn(launch_mod.Node(host, 0), env, cmd,
+                                 cwd=self._cwd)
+        sink: List[str] = []
+        drain = threading.Thread(target=launch_mod._drain,
+                                 args=(proc, sink), daemon=True)
+        drain.start()
+        with self._lock:
+            self._procs[rank] = proc
+            self._sinks[rank] = sink
+            self._drains[rank] = drain
+            self._hosts[rank] = host
+            self._generations[rank] = generation
+
+    def _wait_addr(self, rank: int, generation: int,
+                   timeout: float) -> Tuple[str, int]:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for r, addr, gen in read_rendezvous(self.rdv_dir):
+                if r == rank and gen >= generation:
+                    with self._lock:
+                        self.worker_addrs[rank] = addr
+                    return addr
+            with self._lock:
+                proc = self._procs.get(rank)
+            if proc is not None and proc.poll() is not None:
+                raise RuntimeError(
+                    f"fleet worker {rank} exited rc={proc.returncode} "
+                    f"before rendezvous:\n{self.output_tail(rank)}")
+            time.sleep(0.05)
+        raise TimeoutError(f"fleet worker {rank} did not rendezvous "
+                           f"within {timeout}s")
+
+    def start(self, timeout: float = DEFAULT_READY_TIMEOUT_S
+              ) -> "ProcessServeGang":
+        for rank in range(self.world):
+            self._spawn(rank, 0, restore=False)
+        for rank in range(self.world):
+            self._wait_addr(rank, 0, timeout)
+        self._journal({"event": "fleet-start", "world": self.world,
+                       "workers": {str(r): list(a) for r, a
+                                   in self.worker_addrs.items()}})
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         daemon=True,
+                                         name="harp-fleet-monitor")
+        self._monitor.start()
+        return self
+
+    def output_tail(self, rank: int, lines: int = 40) -> str:
+        with self._lock:
+            sink = list(self._sinks.get(rank, ()))
+        return "".join(sink[-lines:])
+
+    # -- clients ------------------------------------------------------------
+
+    def make_client(self, **kw):
+        from harp_tpu.serve.router import RouterClient
+
+        with self._lock:
+            rank = next(self._client_ranks)
+            peers = dict(self.worker_addrs)
+        client = RouterClient(rank, peers, self.placement,
+                              secret=self.secret, **kw)
+        with self._lock:
+            self._clients[rank] = client.transport.address
+            self._client_objs.append(client)
+        return client
+
+    # -- supervision --------------------------------------------------------
+
+    def _monitor_loop(self) -> None:
+        while not self._stopping.is_set():
+            with self._lock:
+                live = list(self._procs.items())
+            for rank, proc in live:
+                rc = proc.poll()
+                if rc is None or self._stopping.is_set():
+                    continue
+                with self._lock:
+                    # only the CURRENT generation's death is actionable
+                    if self._procs.get(rank) is not proc:
+                        continue
+                    del self._procs[rank]
+                    generation = self._generations[rank]
+                cause = classify_exit(rc)
+                self.metrics.count(f"fleet.deaths.{cause}")
+                with self._lock:
+                    host = self._hosts.get(rank)
+                self._journal({
+                    "event": "worker-death", "rank": rank, "rc": rc,
+                    "cause": cause, "generation": generation,
+                    "host": host,
+                    "placement_version": self.placement_version,
+                    "slo_incident_ranks":
+                        _fresh_incidents(self.telemetry_dir)})
+                if cause != "clean" and self.recover_on_death \
+                        and not self._stopping.is_set():
+                    try:
+                        self.recover(rank, cause)
+                    except (RuntimeError, TimeoutError, OSError,
+                            ConnectionError) as e:
+                        # spawn/rendezvous/push failures: journaled, the
+                        # monitor itself survives to watch the rest
+                        self._journal({"event": "recover-failed",
+                                       "rank": rank, "error": repr(e)})
+            time.sleep(0.05)
+
+    def recover(self, rank: int, cause: str,
+                timeout: float = DEFAULT_READY_TIMEOUT_S) -> None:
+        """Bring a replacement up for ``rank`` and re-route the gang: the
+        spare path (zero-build + reshard-engine restore at the current
+        factor epoch), host retirement on vanish (spare pool consulted —
+        the vanished machine is never respawned onto), then a VERSIONED
+        placement push to every surviving worker and every minted client.
+        The surviving ranks serve throughout."""
+        with self._lock:
+            generation = self._generations.get(rank, 0) + 1
+            old_host = self._hosts.get(rank, "localhost")
+        host = old_host
+        if cause in ("vanish", "watchdog"):
+            # the host is retired; a probed-healthy spare takes the rank
+            # (same contract as supervisor._apply_placement), falling back
+            # to localhost for single-host fleets
+            host = "localhost"
+            while True:
+                with self._lock:
+                    cand = (self.spare_hosts.pop(0) if self.spare_hosts
+                            else None)
+                if cand is None:
+                    break
+                if launch_mod.probe_host(cand):
+                    host = cand
+                    break
+                self._journal({"event": "spare-unreachable", "host": cand})
+        self._spawn(rank, generation, restore=True, host=host)
+        addr = self._wait_addr(rank, generation, timeout)
+        self.metrics.count("fleet.recoveries")
+        self._push_placement()
+        self._journal({
+            "event": "replaced", "rank": rank, "cause": cause,
+            "generation": generation, "old_host": old_host,
+            "new_host": host, "address": list(addr),
+            "restored_version": self.current_version,
+            "placement_version": self.placement_version,
+            "slo_incident_ranks": _fresh_incidents(self.telemetry_dir)})
+
+    def _push_placement(self) -> None:
+        with self._lock:
+            self.placement_version += 1
+            frame = protocol.make_placement(
+                self.placement, dict(self.worker_addrs),
+                self.placement_version)
+            dests = ({r: a for r, a in self.worker_addrs.items()}
+                     | dict(self._clients))
+        for dest, addr in dests.items():
+            self._transport.add_peer(dest, addr)
+            try:
+                self._transport.send(dest, frame)
+            except (KeyError, ConnectionError):
+                # a gone client/worker misses the push; the pull side
+                # (placement_get on retry) covers it
+                self.metrics.count("fleet.placement_push_failures")
+
+    # -- live refresh -------------------------------------------------------
+
+    def push_refresh(self, version: int) -> None:
+        """Push factor epoch ``version`` into the LIVE gang: every worker
+        regenerates its spec's canonical table for that epoch and
+        ``push_epoch``\\ s it while serving — replies flip from the old
+        version to the new atomically per dispatch, never torn. Spares
+        spawned later restore AT this version."""
+        with self._lock:
+            self.current_version = int(version)
+            dests = dict(self.worker_addrs)
+        frame = {"kind": protocol.CONTROL, "op": "refresh",
+                 "version": int(version)}
+        for dest, addr in dests.items():
+            self._transport.add_peer(dest, addr)
+            try:
+                self._transport.send(dest, frame)
+            except (KeyError, ConnectionError):
+                self.metrics.count("fleet.refresh_push_failures")
+        self._journal({"event": "refresh-pushed",
+                       "version": int(version)})
+
+    # -- shutdown -----------------------------------------------------------
+
+    def stop(self, timeout: float = 60.0) -> None:
+        self._stopping.set()
+        with open(os.path.join(self.rdv_dir, "stop"), "w"):
+            pass
+        if self._monitor is not None:
+            self._monitor.join(timeout)
+        with self._lock:
+            procs = dict(self._procs)
+            drains = dict(self._drains)
+        deadline = time.monotonic() + timeout
+        for rank, proc in procs.items():
+            try:
+                proc.wait(max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        for t in drains.values():
+            t.join(5.0)
+        with self._lock:
+            clients = list(self._client_objs)
+        for c in clients:
+            try:
+                c.close()
+            except (OSError, RuntimeError):
+                pass                 # socket/thread teardown of a corpse
+        self._transport.close()
+        self._journal({"event": "fleet-stop"})
+
+    def __enter__(self) -> "ProcessServeGang":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+# --------------------------------------------------------------------------- #
+# In-process fleet (the tier-1 / CI-smoke topology)
+# --------------------------------------------------------------------------- #
+
+class LocalFleet:
+    """Supervise an in-process ``local_gang``: a worker that dies abruptly
+    (the chaos grammar's in-process ``kill`` → ``ServeWorker.die()``) is
+    replaced by a twin on a fresh port, its top-k stores re-materialized
+    from the canonical factor table through the on-device reshard engine,
+    and the bumped placement applied to every survivor and adopted client
+    — the same recovery contract as :class:`ProcessServeGang`, minus the
+    OS-process boundary. ``canonical`` maps model name → the canonical
+    user-factor source the restore reads: a ``callable(version) ->
+    table`` regenerates the endpoint's CURRENT epoch (the deterministic
+    spec builders' shape), while a bare array describes epoch 0 ONLY —
+    after a live refresh it is STALE, so the restore is skipped (and
+    journaled) rather than silently overwriting fresh factors with old
+    rows labeled as the new epoch. None skips the restore entirely: the
+    in-process mesh state survived the worker's threads."""
+
+    def __init__(self, workers: List, make_client: Callable, *,
+                 canonical: Optional[Dict[str, np.ndarray]] = None,
+                 telemetry_dir: Optional[str] = None,
+                 journal_path: Optional[str] = None,
+                 poll_interval_s: float = 0.02, metrics=None):
+        if metrics is None:
+            from harp_tpu.utils.metrics import DEFAULT as metrics
+        self.metrics = metrics
+        self.placement = dict(workers[0].placement)
+        self.canonical = dict(canonical or {})
+        self.telemetry_dir = telemetry_dir
+        self.journal = _Journal(journal_path)
+        self.placement_version = 0
+        self._make_client = make_client
+        self._poll_interval_s = poll_interval_s
+        self._lock = threading.Lock()
+        self._workers: Dict[int, object] = {w.rank: w for w in workers}
+        self._clients: list = []
+        self._stopping = threading.Event()
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         daemon=True,
+                                         name="harp-localfleet-monitor")
+        self._monitor.start()
+
+    def make_client(self, **kw):
+        client = self._make_client(**kw)
+        with self._lock:
+            self._clients.append(client)
+        return client
+
+    def workers(self) -> List:
+        with self._lock:
+            return list(self._workers.values())
+
+    def _journal(self, record: dict) -> None:
+        # appended from the monitor thread and the caller's thread alike
+        with self._lock:
+            self.journal.append(record)
+
+    def _monitor_loop(self) -> None:
+        while not self._stopping.is_set():
+            with self._lock:
+                dead = [w for w in self._workers.values() if w.died]
+            for w in dead:
+                if self._stopping.is_set():
+                    break
+                try:
+                    self.recover(w)
+                except (RuntimeError, ValueError, OSError,
+                        ConnectionError) as e:
+                    # respawn/restore failures: journaled, monitor survives
+                    self._journal({"event": "recover-failed",
+                                   "rank": w.rank, "error": repr(e)})
+            time.sleep(self._poll_interval_s)
+
+    def recover(self, dead) -> object:
+        """Replace one dead worker (idempotent per corpse: a second call
+        for the same object is a no-op). Returns the replacement."""
+        from harp_tpu.serve.endpoints import TopKEndpoint
+        from harp_tpu.serve.router import ServeWorker
+
+        if not dead.died:
+            raise RuntimeError(
+                f"worker {dead.rank} was closed cleanly, not died — "
+                f"recover() is for corpses (die()/chaos kill)")
+        with self._lock:
+            if self._workers.get(dead.rank) is not dead:
+                return self._workers.get(dead.rank)
+            survivors = [w for w in self._workers.values()
+                         if w is not dead and not w._closed]
+            peers = {w.rank: w.address for w in survivors}
+        self._journal({
+            "event": "worker-death", "rank": dead.rank, "cause": "died",
+            "placement_version": self.placement_version,
+            "slo_incident_ranks": _fresh_incidents(self.telemetry_dir)})
+        restored = {}
+        skipped = {}
+        for name, ep in dead.endpoints.items():
+            source = self.canonical.get(name)
+            if source is None or not isinstance(ep, TopKEndpoint):
+                continue
+            if callable(source):
+                table = source(ep.version)
+            elif ep.version != 0:
+                # a frozen table only describes epoch 0: restoring it
+                # over refreshed factors would serve stale rows labeled
+                # with the fresh version — skip, loudly
+                skipped[name] = ep.version
+                continue
+            else:
+                table = source
+            # re-materialize through the reshard engine at the epoch
+            # the endpoint currently announces — the spare path
+            restored[name] = ep.restore_full(table, version=ep.version)
+        if skipped:
+            self._journal({"event": "restore-skipped-stale-canonical",
+                           "rank": dead.rank, "epochs": skipped})
+        replacement = ServeWorker(
+            dead.session, dead.rank, dead.endpoints, self.placement,
+            peers=peers, secret=dead._secret,
+            max_wait_s=dead.max_wait_s, metrics=dead.metrics,
+            slo=dead.slo, cache=dead.cache)
+        with self._lock:
+            self._workers[dead.rank] = replacement
+            self.placement_version += 1
+            version = self.placement_version
+            all_peers = {**peers, dead.rank: replacement.address}
+            clients = list(self._clients)
+            gang = list(self._workers.values())
+        for w in gang:
+            w.apply_placement(self.placement, all_peers, version)
+        for c in clients:
+            c.apply_placement(self.placement, all_peers, version)
+        self.metrics.count("fleet.recoveries")
+        self._journal({
+            "event": "replaced", "rank": dead.rank,
+            "address": list(replacement.address),
+            "restored_rows": restored, "placement_version": version,
+            "slo_incident_ranks": _fresh_incidents(self.telemetry_dir)})
+        return replacement
+
+    def close(self, close_workers: bool = True) -> None:
+        self._stopping.set()
+        self._monitor.join(5.0)
+        if close_workers:
+            for w in self.workers():
+                w.close()
+
+    def __enter__(self) -> "LocalFleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -18,11 +18,31 @@ Reply::
 
     {"kind": "serve.reply", "id": ..., "ok": bool, "result": ...,
      "error": None|"shutting-down: ..."|..., "served_by": rank,
-     "batch": n, "bucket": B}
+     "batch": n, "bucket": B, "version": V}
 
 ``batch``/``bucket`` expose the micro-batcher's coalescing (how many
 requests rode this dispatch, into which static bucket) — the load generator
 derives its occupancy stats from them without touching the server.
+``version`` is the endpoint's factor-epoch at dispatch time (ISSUE 14 live
+refresh): every row of one coalesced dispatch is answered by exactly one
+epoch, snapshotted under the endpoint's resident lock, so a client can
+assert it never saw a torn read across a live ``push_epoch`` swap. ``None``
+= the endpoint is unversioned (classify) or the reply predates a dispatch
+(errors).
+
+Fleet control frames (ISSUE 14 — the placement map became mutable)::
+
+    {"kind": "serve.placement", "version": v,
+     "placement": {model: rank}, "peers": {rank: (host, port)}}
+    {"kind": "serve.placement_get", "reply_to": (rank, host, port)}
+
+``serve.placement`` is pushed by the fleet supervisor after a re-placement
+(dead worker's models re-routed / spare swapped in at a new address) and
+applied by workers AND clients iff ``version`` is newer than what they
+hold — a reordered stale frame can never roll the gang's routing back.
+``serve.placement_get`` is the pull side: a client whose request path just
+failed asks any surviving worker for the current map instead of waiting to
+be found. Both ride the same authenticated transport as requests.
 
 A SAMPLED request additionally carries a ``"trace"`` dict
 (:mod:`harp_tpu.telemetry.spans`): per-stage wall-clock stamps appended at
@@ -40,6 +60,12 @@ from typing import Any, Optional, Tuple
 
 REQUEST = "serve.request"
 REPLY = "serve.reply"
+PLACEMENT = "serve.placement"
+PLACEMENT_GET = "serve.placement_get"
+# fleet-operator frames a worker forwards to its installed on_control hook
+# (serve/worker.py handles {"op": "refresh", "version": V} — the process
+# gang's live model refresh push)
+CONTROL = "serve.control"
 
 OP_TOPK = "topk"
 OP_CLASSIFY = "classify"
@@ -49,6 +75,15 @@ ERR_SHUTTING_DOWN = "shutting-down"
 ERR_UNKNOWN_MODEL = "unknown-model"
 ERR_DISPATCH = "dispatch-error"
 ERR_DEADLINE = "deadline-exceeded"
+# transient routing failure: the receiving worker could not forward to the
+# model's owner (owner died mid-window / stale map) — retryable, the client
+# re-syncs placement and resubmits
+ERR_FORWARD = "forward-failed"
+# client-side synthetic reply (never on the wire): an in-flight request's
+# rank was marked dead / replaced at a new address — the at-most-once
+# transport guarantees the reply can never come, so the future is failed
+# NOW instead of hanging to its timeout; retryable by request_retry
+ERR_DEAD_RANK = "dead-rank"
 
 
 class ServeError(RuntimeError):
@@ -70,7 +105,21 @@ def make_request(req_id: str, op: str, model: str, data: Any,
 def make_reply(request: dict, ok: bool, result: Any = None,
                error: Optional[str] = None, served_by: Optional[int] = None,
                batch: Optional[int] = None,
-               bucket: Optional[int] = None) -> dict:
+               bucket: Optional[int] = None,
+               version: Optional[int] = None) -> dict:
     return {"kind": REPLY, "id": request["id"], "ok": bool(ok),
             "result": result, "error": error, "served_by": served_by,
-            "batch": batch, "bucket": bucket}
+            "batch": batch, "bucket": bucket, "version": version}
+
+
+def make_placement(placement: dict, peers: dict, version: int) -> dict:
+    """A versioned placement push: the authoritative ``{model: rank}`` map
+    plus every serving rank's dial address. Peers ride as plain tuples —
+    the frame must survive version skew like every other frame here."""
+    return {"kind": PLACEMENT, "version": int(version),
+            "placement": dict(placement),
+            "peers": {int(r): (h, int(p)) for r, (h, p) in peers.items()}}
+
+
+def make_placement_get(reply_to: Tuple[int, str, int]) -> dict:
+    return {"kind": PLACEMENT_GET, "reply_to": tuple(reply_to)}

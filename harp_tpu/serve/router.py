@@ -75,14 +75,28 @@ def _close_at_exit() -> None:
 
 
 class ServeWorker:
-    """One serving gang member: transport + per-model micro-batchers."""
+    """One serving gang member: transport + per-model micro-batchers.
+
+    Fleet surface (ISSUE 14): the placement map is MUTABLE — a
+    :mod:`~harp_tpu.serve.fleet` supervisor pushes versioned
+    ``serve.placement`` frames after a re-placement and this worker applies
+    them (:meth:`apply_placement`); clients pull the current map with
+    ``serve.placement_get``. ``cache`` installs a hot-key reply cache
+    (:class:`~harp_tpu.serve.cache.TopKReplyCache`) consulted before the
+    batcher; ``fault_exit`` selects how the serving chaos grammar
+    (``HARP_FAULT=kill@request=N``…) executes on this worker — a
+    subprocess worker dies ``os._exit`` (classifiable by the supervisor),
+    an in-process worker dies abruptly through :meth:`die`.
+    """
 
     def __init__(self, session, rank: int, endpoints: Dict[str, object],
                  placement: Dict[str, int], *,
                  peers: Optional[Dict[int, Tuple[str, int]]] = None,
                  secret: Optional[bytes] = None, host: str = "127.0.0.1",
                  max_wait_s: float = DEFAULT_MAX_WAIT_S, metrics=None,
-                 slo=None, metrics_port: Optional[int] = None):
+                 slo=None, metrics_port: Optional[int] = None,
+                 cache=None, fault_exit: bool = False,
+                 on_control: Optional[Callable[[dict], None]] = None):
         if metrics is None:
             from harp_tpu.utils.metrics import DEFAULT as metrics
         self.session = session
@@ -90,14 +104,28 @@ class ServeWorker:
         self.placement = dict(placement)
         self.endpoints = dict(endpoints)
         # gang ranks are reserved: a reply_to rank colliding with a serving
-        # worker must never overwrite the forwarding route to that worker
+        # worker must never overwrite the forwarding route to that worker.
+        # placement/_worker_ranks/placement_version mutate together under
+        # _placement_lock (receive thread applies pushed frames, the fleet
+        # supervisor may apply directly from its own thread)
         self._worker_ranks = set(self.placement.values()) | {rank}
+        self._placement_lock = threading.Lock()
+        self.placement_version = 0
+        self.cache = cache
+        self._fault_exit = bool(fault_exit)
+        self.on_control = on_control
+        # receive-thread-only counter driving the serving fault grammar
+        # (request=N trigger points); no lock — single-writer, single-reader
+        self._requests_seen = 0
         self.metrics = metrics
         # the serving-plane observability hooks (both optional): an
         # SLOWatchdog fed one (age, ok) sample per reply, and a per-worker
         # pull exporter (metrics_port=0 binds an ephemeral port — read it
         # back from worker.exporter.port)
         self.slo = slo
+        self.max_wait_s = max_wait_s
+        self._secret = secret        # the fleet respawns a dead worker's
+        #                              twin with the same transport auth
         self.exporter = None
         if metrics_port is not None:
             from harp_tpu.telemetry.exporter import MetricsExporter
@@ -121,6 +149,10 @@ class ServeWorker:
         self._draining = threading.Event()
         self._close_lock = threading.Lock()
         self._closed = False
+        # set ONLY by die(): the fleet monitor keys recovery on this, so
+        # a cleanly close()d worker (shutdown, atexit sweep) is never
+        # mistaken for a corpse and resurrected
+        self.died = False
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
@@ -140,8 +172,36 @@ class ServeWorker:
             if ev is None:
                 continue
             payload = ev.payload
-            if not (isinstance(payload, dict)
-                    and payload.get("kind") == protocol.REQUEST):
+            kind = payload.get("kind") if isinstance(payload, dict) else None
+            if kind == protocol.PLACEMENT:
+                try:
+                    self.apply_placement(payload.get("placement") or {},
+                                         payload.get("peers") or {},
+                                         payload.get("version", 0))
+                except (TypeError, ValueError, AttributeError, IndexError,
+                        KeyError):
+                    # version-skewed frame shapes (non-dict placement,
+                    # short address tuples) must cost one dropped frame,
+                    # never the receive thread
+                    self.metrics.count("serve.malformed_placements")
+                continue
+            if kind == protocol.PLACEMENT_GET:
+                self._answer_placement_get(payload)
+                continue
+            if kind == protocol.CONTROL:
+                if self.on_control is not None:
+                    try:
+                        self.on_control(payload)
+                    except Exception:
+                        # an operator frame must never cost the receive
+                        # loop — same lifeline rule as request handling
+                        import logging
+
+                        logging.getLogger("harp_tpu.serve").exception(
+                            "control frame handler failed")
+                        self.metrics.count("serve.control_errors")
+                continue
+            if kind != protocol.REQUEST:
                 self.metrics.count("serve.non_request_events")
                 continue
             try:
@@ -160,11 +220,47 @@ class ServeWorker:
     def _handle(self, msg: dict) -> None:
         self.metrics.count("serve.requests")
         spans.stamp(msg, spans.RECV)
+        # the serving chaos grammar (HARP_FAULT=kill|vanish|slow@request=N):
+        # a scripted death/straggle lands HERE, on the receive path with
+        # requests in flight — the scenario the recovery machinery exists
+        # for. Subprocess workers exit with the classification code;
+        # in-process workers die abruptly via die().
+        from harp_tpu.parallel import faults
+
+        self._requests_seen += 1
+        hook = None if self._fault_exit else self.die
+        faults.serve_fire(self._requests_seen, rank=self.rank,
+                          on_kill=hook, on_vanish=hook)
+        if self._closed:
+            return                   # the fault just killed this worker
         if self._draining.is_set():
             self._reply(msg, ok=False, error=protocol.ERR_SHUTTING_DOWN)
             return
         model = msg.get("model")
-        owner = self.placement.get(model, self.rank)
+        ep = self.endpoints.get(model)
+        if self.cache is not None and msg.get("op") == protocol.OP_TOPK:
+            # hot-key fast path: a fresh same-epoch reply skips the route
+            # + coalesce + dispatch stack — and on a NON-owner router
+            # (ep is None) even the forward hop: a shared cache's
+            # latest-known epoch for the model stands in for the owner's
+            # version, which is what makes the hot rows effectively
+            # replicated at every router (the version key still makes a
+            # post-refresh stale hit impossible — see serve/cache.py)
+            if ep is not None and getattr(ep, "op", None) == \
+                    protocol.OP_TOPK:
+                version = getattr(ep, "version", None)
+                hit = self.cache.get(model, msg.get("data"), version)
+            elif ep is None:
+                hit_v = self.cache.get_latest(model, msg.get("data"))
+                hit, version = hit_v if hit_v is not None else (None,
+                                                               None)
+            else:
+                hit = None
+            if hit is not None:
+                self._reply(msg, ok=True, result=hit, version=version)
+                return
+        with self._placement_lock:
+            owner = self.placement.get(model, self.rank)
         if owner != self.rank:
             # fan out to the owning worker; reply_to stays the client's, so
             # the answer travels owner -> client directly
@@ -173,8 +269,12 @@ class ServeWorker:
                 self.transport.send(owner, msg)
                 self.metrics.count("serve.forwarded")
             except (KeyError, ConnectionError) as e:
+                # a TRANSIENT routing state (owner died mid-window, stale
+                # map): the prefixed error is retryable — the client
+                # re-syncs placement and resubmits
                 self._reply(msg, ok=False,
-                            error=f"forward to worker {owner} failed: {e}")
+                            error=f"{protocol.ERR_FORWARD}: to worker "
+                                  f"{owner}: {e}")
             return
         batcher = self.batchers.get(model)
         if batcher is None:
@@ -186,16 +286,82 @@ class ServeWorker:
         if not batcher.submit(msg):
             self._reply(msg, ok=False, error=protocol.ERR_SHUTTING_DOWN)
 
+    # -- fleet control plane (mutable placement) ---------------------------
+
+    def apply_placement(self, placement: Dict[str, int],
+                        peers: Dict[int, Tuple[str, int]],
+                        version: int) -> bool:
+        """Adopt a NEWER versioned placement map + peer addresses (pushed
+        by the fleet supervisor after a re-placement, or received as a
+        ``serve.placement`` frame). A stale or same-version frame is a
+        no-op — reordered pushes can never roll routing back. Returns
+        whether the map was applied."""
+        # normalize BOTH fields before touching any state: a frame that
+        # is malformed anywhere (version skew) must apply NOTHING — a
+        # torn half-applied map is worse than a dropped frame
+        version = int(version)
+        placement = {str(m): int(r) for m, r in placement.items()}
+        peers = {int(r): (a[0], int(a[1])) for r, a in peers.items()}
+        with self._placement_lock:
+            if version <= self.placement_version:
+                return False
+            self.placement = placement
+            self._worker_ranks = set(placement.values()) | {self.rank}
+            self.placement_version = version
+        for r, addr in peers.items():
+            if r != self.rank:
+                self.transport.add_peer(r, addr)
+        self.metrics.count("serve.placement_updates")
+        return True
+
+    def placement_frame(self) -> dict:
+        """The current versioned placement as a pushable frame — peer
+        addresses are whatever this worker can dial (its own address
+        included), which is exactly what a client needs to re-route."""
+        known = self.transport.peers()
+        with self._placement_lock:
+            placement = dict(self.placement)
+            version = self.placement_version
+            ranks = set(self.placement.values())
+        peers = {r: known[r] for r in ranks if r in known}
+        peers[self.rank] = self.address
+        return protocol.make_placement(placement, peers, version)
+
+    def _answer_placement_get(self, msg: dict) -> None:
+        try:
+            rank, rhost, rport = msg["reply_to"]
+            rank, rport = int(rank), int(rport)
+        except (KeyError, TypeError, ValueError):
+            self.metrics.count("serve.unroutable_replies")
+            return
+        with self._placement_lock:
+            collision = rank in self._worker_ranks
+        if collision:
+            self.metrics.count("serve.reply_rank_collisions")
+            return
+        self.transport.add_peer(rank, (rhost, rport))
+        try:
+            self.transport.send(rank, self.placement_frame())
+        except (OSError, TypeError):
+            self.metrics.count("serve.lost_replies")
+
     # -- reply path ---------------------------------------------------------
 
     def _make_reply_fn(self) -> Callable:
-        def reply(msg, ok, result=None, error=None, batch=None, bucket=None):
+        def reply(msg, ok, result=None, error=None, batch=None, bucket=None,
+                  version=None):
+            if (ok and self.cache is not None
+                    and msg.get("op") == protocol.OP_TOPK):
+                # fill AT the reply boundary: the result was computed under
+                # exactly `version` (snapshotted with the dispatch state)
+                self.cache.put(msg.get("model"), msg.get("data"), version,
+                               result)
             self._reply(msg, ok=ok, result=result, error=error, batch=batch,
-                        bucket=bucket)
+                        bucket=bucket, version=version)
         return reply
 
     def _reply(self, msg: dict, ok: bool, result=None, error=None,
-               batch=None, bucket=None) -> None:
+               batch=None, bucket=None, version=None) -> None:
         if self.slo is not None:
             # one (age, ok) sample per reply: age = now − the client's
             # submit wall, i.e. end-to-end minus the reply hop — the
@@ -212,7 +378,9 @@ class ServeWorker:
             # reply is unroutable, the serving thread must not die for it
             self.metrics.count("serve.unroutable_replies")
             return
-        if rank in self._worker_ranks:
+        with self._placement_lock:
+            collision = rank in self._worker_ranks
+        if collision:
             # a client claiming a serving worker's rank would hijack the
             # gang's forwarding route if we add_peer'd it — drop the reply
             # (the client is misconfigured; local_gang mints client ranks
@@ -222,7 +390,8 @@ class ServeWorker:
         self.transport.add_peer(rank, (rhost, rport))
         reply = protocol.make_reply(
             msg, ok=ok, result=result, error=error,
-            served_by=self.rank, batch=batch, bucket=bucket)
+            served_by=self.rank, batch=batch, bucket=bucket,
+            version=version)
         tr = msg.get(spans.TRACE_KEY)
         if tr is not None:
             # the accumulated trace rides the reply home: the CLIENT holds
@@ -244,6 +413,35 @@ class ServeWorker:
         """Stop ACCEPTING: from now on new requests get a clean
         "shutting-down" reply while already-accepted batches finish."""
         self._draining.set()
+
+    def die(self) -> None:
+        """ABRUPT death — the in-process stand-in for ``os._exit`` that
+        the serving chaos grammar (``kill@request=N``) uses when the
+        worker shares the test process: the transport is torn down NOW,
+        accepted-but-unserved requests are dropped unanswered (their
+        clients time out and retry — exactly what a real process death
+        does to them), nothing drains, nothing replies shutting-down.
+        The thread/socket bookkeeping still runs so the corpse leaks no
+        OS resources into the rest of the suite. Idempotent with close().
+        """
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+            self.died = True
+        self._stop.set()
+        # kill the transport FIRST: replies of any still-running dispatch
+        # must hit a dead socket, like a real crash mid-batch
+        self.transport.close()
+        for b in self.batchers.values():
+            b.kill()
+        if threading.current_thread() is not self._thread:
+            # the chaos hook fires ON the receive thread (a worker killing
+            # itself mid-request) — that thread exits via the _stop flag
+            self._thread.join(5.0)
+        if self.exporter is not None:
+            self.exporter.close()
+        _unregister_live(self)
 
     def close(self, timeout: float = 30.0) -> None:
         """Drain in-flight micro-batches, stop threads, close the
@@ -345,8 +543,19 @@ class RouterClient:
         self.transport = P2PTransport(self.queue, rank=rank,
                                       peers=dict(peers), secret=secret,
                                       host=host)
-        self._waiting: Dict[str, _PendingReply] = {}
+        # rid -> (dest rank, pending): the dest rides along so in-flight
+        # requests to a rank that just died/moved can be failed FAST
+        self._waiting: Dict[str, Tuple[int, _PendingReply]] = {}
         self._lock = threading.Lock()
+        # fleet state (ISSUE 14): the placement map is mutable (versioned
+        # pushes / placement_get pulls), and ranks observed dead are
+        # marked so submits to them FAIL FAST instead of paying a reply
+        # timeout. All guarded by _lock; sync_placement waiters ride the
+        # condition (notified per received placement frame).
+        self.placement_version = 0
+        self._dead_ranks: set = set()
+        self._placement_seen = 0
+        self._placement_cv = threading.Condition(self._lock)
         self._ids = itertools.count()
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -365,16 +574,28 @@ class RouterClient:
             if ev is None:
                 continue
             payload = ev.payload
-            if not (isinstance(payload, dict)
-                    and payload.get("kind") == protocol.REPLY):
+            if not isinstance(payload, dict):
+                continue
+            if payload.get("kind") == protocol.PLACEMENT:
+                try:
+                    self.apply_placement(payload.get("placement") or {},
+                                         payload.get("peers") or {},
+                                         payload.get("version", 0))
+                except (TypeError, ValueError, AttributeError, IndexError,
+                        KeyError):
+                    # same contract as the worker loop: a skewed frame is
+                    # one dropped frame, never the client's lifeline
+                    self.metrics.count("serve.malformed_placements")
+                continue
+            if payload.get("kind") != protocol.REPLY:
                 continue
             tr = payload.get(spans.TRACE_KEY)
             if tr is not None:
                 spans.stamp_trace(tr, spans.REPLY_RECV)
             with self._lock:
-                pending = self._waiting.pop(payload.get("id"), None)
-            if pending is not None:
-                pending._set(payload)
+                entry = self._waiting.pop(payload.get("id"), None)
+            if entry is not None:
+                entry[1]._set(payload)
             if tr is not None:
                 self._finish_span(tr)
 
@@ -392,18 +613,234 @@ class RouterClient:
         except (KeyError, TypeError, ValueError, IndexError):
             self.metrics.count("serve.spans_malformed")
 
+    # -- fleet surface (ISSUE 14) -------------------------------------------
+
+    def apply_placement(self, placement: Dict[str, int],
+                        peers: Dict[int, Tuple[str, int]],
+                        version: int) -> bool:
+        """Adopt a versioned placement map + worker addresses (a pushed
+        ``serve.placement`` frame, a ``placement_get`` answer, or the
+        fleet supervisor calling in directly). Addresses are ALWAYS
+        refreshed (add_peer drops a stale pooled connection on change);
+        the map itself only moves forward — a stale frame cannot roll
+        routing back. A rank the frame re-announces is alive again: its
+        dead mark clears (a replaced worker rejoins at the same rank,
+        new address). Returns whether the MAP was applied."""
+        # normalize the whole frame BEFORE mutating anything (same
+        # no-torn-application rule as the worker side)
+        version = int(version)
+        placement = {str(m): int(r) for m, r in placement.items()}
+        peers = {int(r): (a[0], int(a[1])) for r, a in peers.items()}
+        old = self.transport.peers()
+        moved = [r for r, addr in peers.items()
+                 if r in old and old[r] != addr]
+        for r, addr in peers.items():
+            self.transport.add_peer(r, addr)
+        for r in moved:
+            # a rank re-announced at a NEW address was replaced: whatever
+            # was in flight to the old incarnation can never be answered
+            # (at-most-once transport) — fail it now, the retry layer
+            # resubmits against the replacement
+            self._fail_inflight(r, f"rank {r} was replaced at {peers[r]}")
+        with self._placement_cv:
+            self._placement_seen += 1
+            applied = version > self.placement_version
+            if applied:
+                self.placement = placement
+                self.placement_version = version
+            # a frame re-announcing a rank's address means the sender
+            # believes it is alive — clear its dead mark even when the
+            # MAP is same-version (a transient send failure must not
+            # brick a healthy rank for this client until some unrelated
+            # recovery bumps the version; if the rank really is dead the
+            # next submit re-marks it in ~one failed connect)
+            self._dead_ranks -= set(peers)
+            self._placement_cv.notify_all()
+        if applied:
+            self.metrics.count("serve.placement_updates")
+        return applied
+
+    def mark_dead(self, rank: int) -> None:
+        """Record a rank as dead: submits routed to it now FAIL FAST
+        (ConnectionError at submit, no reply timeout paid) until a
+        placement frame re-announces the rank. The retry layer marks a
+        rank on send failure; the fleet supervisor may mark it the moment
+        the death is detected."""
+        with self._lock:
+            self._dead_ranks.add(int(rank))
+        self.metrics.count("serve.client_dead_marks")
+        self._fail_inflight(int(rank), f"rank {rank} marked dead")
+
+    def _fail_inflight(self, rank: int, reason: str) -> None:
+        """Fail every in-flight future addressed to ``rank`` with a
+        synthetic retryable dead-rank reply — the tentpole's 'in-flight
+        requests to the dead rank are failed fast and retried, never
+        hung': the at-most-once transport guarantees no real reply can
+        arrive once the rank is dead or replaced."""
+        with self._lock:
+            victims = [(rid, p) for rid, (dest, p)
+                       in self._waiting.items() if dest == rank]
+            for rid, _p in victims:
+                del self._waiting[rid]
+        for rid, p in victims:
+            p._set({"kind": protocol.REPLY, "id": rid, "ok": False,
+                    "result": None, "served_by": None, "batch": None,
+                    "bucket": None, "version": None,
+                    "error": f"{protocol.ERR_DEAD_RANK}: {reason}"})
+        if victims:
+            self.metrics.count("serve.client_inflight_failed_fast",
+                               len(victims))
+
+    def sync_placement(self, timeout: float = 5.0) -> bool:
+        """Pull the current placement from the surviving workers: send
+        ``placement_get`` to every known non-dead worker rank and wait for
+        any placement frame to arrive (newer maps apply, a same-version
+        answer still satisfies the wait — the caller asked 'what is the
+        map now', not 'give me a newer one'). Returns False when nobody
+        answered within ``timeout``."""
+        with self._lock:
+            targets = sorted(
+                (set(self.placement.values())
+                 | set(self.transport.peers()))
+                - self._dead_ranks - {self.rank})
+            seen0 = self._placement_seen
+        frame = protocol.make_placement_get(
+            (self.rank,) + tuple(self.transport.address))
+        sent = False
+        for t in targets:
+            try:
+                self.transport.send(t, frame)
+                sent = True
+            except KeyError:
+                continue             # no address for t — nothing to dial
+            except ConnectionError:
+                self.mark_dead(t)
+        if not sent:
+            return False
+        deadline = time.monotonic() + timeout
+        with self._placement_cv:
+            while self._placement_seen == seen0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._placement_cv.wait(remaining)
+        return True
+
+    def request_retry(self, op: str, model: str, data, *,
+                      timeout: float = 30.0, attempts: int = 5,
+                      backoff_s: float = 0.05,
+                      backoff_factor: float = 2.0,
+                      backoff_max_s: float = 2.0, jitter: float = 0.5,
+                      sync_timeout: float = 5.0,
+                      sleep: Callable[[float], None] = time.sleep):
+        """Synchronous point query with the fleet's retry contract
+        (ISSUE 14): bounded ``attempts``, exponential backoff with
+        multiplicative jitter between them, and a placement re-sync after
+        every failure so the retry lands on wherever the model lives NOW.
+
+        Failure handling per attempt:
+
+        * owner marked dead / send fails → FAIL FAST (no reply timeout
+          paid), the rank is marked dead, placement re-synced, retried;
+        * reply timeout (worker died holding the request, or a frame was
+          lost — the transport is at-most-once) → pending entry discarded
+          (the waiting map stays bounded), placement re-synced, retried;
+        * a clean ``shutting-down`` reply (worker draining mid-swap) →
+          re-synced and retried;
+        * any other server-reported error (unknown model, dispatch error,
+          deadline) is PERMANENT for this request and raises immediately —
+          retrying a malformed query cannot help.
+
+        Raises the last retryable error once the budget is spent."""
+        import random
+
+        last: Optional[Exception] = None
+        attempts = max(1, attempts)
+        for attempt in range(attempts):
+            def resync():
+                # pointless (and up to sync_timeout of blocking) after
+                # the last attempt — there is no retry left to use it
+                if attempt + 1 < attempts:
+                    self.sync_placement(sync_timeout)
+            if attempt:
+                delay = min(backoff_s * backoff_factor ** (attempt - 1),
+                            backoff_max_s)
+                delay *= 1.0 + jitter * random.random()
+                self.metrics.count("serve.client_retries")
+                sleep(delay)
+            with self._lock:
+                dest = self.placement.get(model, self._default_dest)
+                dead = dest in self._dead_ranks
+            if dead:
+                self.metrics.count("serve.client_fastfail")
+                last = ConnectionError(
+                    f"owner rank {dest} of {model!r} is marked dead")
+                resync()
+                continue
+            try:
+                pending = self.submit(op, model, data, dest=dest)
+            except ConnectionError as e:
+                # the send itself failed — the fast-fail leg: nobody
+                # waited a reply timeout to learn the rank is gone
+                last = e
+                self.mark_dead(dest)
+                self.metrics.count("serve.client_fastfail")
+                resync()
+                continue
+            except KeyError as e:
+                last = e             # no address yet — sync will fetch it
+                resync()
+                continue
+            try:
+                return pending.result(timeout)
+            except TimeoutError as e:
+                # result() already discarded the pending entry — the
+                # waiting map cannot grow through retries
+                last = e
+                self.metrics.count("serve.client_reply_timeouts")
+                resync()
+                continue
+            except protocol.ServeError as e:
+                # shutting-down (draining mid-swap), dead-rank (an
+                # in-flight future failed fast by a placement update),
+                # and forward-failed (a worker's stale map hit the dead
+                # owner) are the transient server states — everything
+                # else is permanent for this request
+                msg = str(e)
+                if protocol.ERR_SHUTTING_DOWN not in msg \
+                        and not msg.startswith(protocol.ERR_DEAD_RANK) \
+                        and not msg.startswith(protocol.ERR_FORWARD):
+                    raise
+                last = e
+                resync()
+                continue
+        assert last is not None
+        raise last
+
+    # -- submit/request -----------------------------------------------------
+
     def submit(self, op: str, model: str, data, *,
                deadline_ts: Optional[float] = None,
                dest: Optional[int] = None) -> _PendingReply:
         """Asynchronously submit one point query; returns the reply future.
         ``dest`` overrides the placement-derived owner (tests exercise the
-        forwarding leg this way)."""
+        forwarding leg this way). A ``dest`` marked dead fails fast with
+        ConnectionError — no socket timeout, no reply wait."""
         if self._closed:
             raise ConnectionError("client is closed")
         n = next(self._ids)
         rid = f"{self.rank}-{n}"
-        if dest is None:
-            dest = self.placement.get(model, self._default_dest)
+        with self._lock:
+            if dest is None:
+                dest = self.placement.get(model, self._default_dest)
+            if dest in self._dead_ranks:
+                dead = True
+            else:
+                dead = False
+        if dead:
+            self.metrics.count("serve.client_fastfail")
+            raise ConnectionError(f"rank {dest} is marked dead — awaiting "
+                                  f"a placement update that revives it")
         msg = protocol.make_request(
             rid, op, model, data,
             reply_to=(self.rank,) + tuple(self.transport.address),
@@ -417,7 +854,7 @@ class RouterClient:
 
         pending = _PendingReply(discard=discard)
         with self._lock:
-            self._waiting[rid] = pending
+            self._waiting[rid] = (dest, pending)
         try:
             self.transport.send(dest, msg)
         except (KeyError, ConnectionError):
@@ -454,7 +891,8 @@ def local_gang(session, worker_endpoints: List[Dict[str, object]], *,
                slo_p99_s: Optional[float] = None,
                slo_kw: Optional[dict] = None,
                metrics_port: Optional[int] = None,
-               trace_sample: Optional[int] = None
+               trace_sample: Optional[int] = None,
+               cache=None
                ) -> Tuple[List[ServeWorker], Callable[..., RouterClient]]:
     """An in-process serving gang on loopback (the tier-1/bench topology;
     multi-host gangs pass explicit peer maps or KV rendezvous instead).
@@ -470,7 +908,10 @@ def local_gang(session, worker_endpoints: List[Dict[str, object]], *,
     ``metrics_port`` starts a per-worker pull exporter (0 = ephemeral
     ports, >0 = ``port + rank`` so same-host workers never collide);
     ``trace_sample`` makes every minted client trace every Nth request
-    (None = the HARP_TRACE_REQUESTS default).
+    (None = the HARP_TRACE_REQUESTS default); ``cache`` installs ONE
+    shared hot-key reply cache (serve/cache.py) across the gang's workers
+    — the in-process fleet's "replicate the hot keys at every router"
+    configuration.
     """
     from harp_tpu.telemetry.watchdog import SLOWatchdog
 
@@ -478,7 +919,7 @@ def local_gang(session, worker_endpoints: List[Dict[str, object]], *,
                  for name in eps}
     workers = [ServeWorker(session, r, eps, placement, peers={},
                            secret=secret, max_wait_s=max_wait_s,
-                           metrics=metrics,
+                           metrics=metrics, cache=cache,
                            slo=(SLOWatchdog(slo_p99_s, rank=r,
                                             metrics=metrics,
                                             **(slo_kw or {}))

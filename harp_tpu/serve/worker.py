@@ -1,0 +1,159 @@
+"""Serving-worker subprocess entry — one fleet member per OS process.
+
+``python -m harp_tpu.serve.worker --spec <spec.json> --rank R`` is what the
+:class:`~harp_tpu.serve.fleet.ProcessServeGang` controller launches through
+the ``parallel/launch`` member-spawn path (one process per serving rank,
+localhost Popen or ssh — the same split the training gang launcher uses).
+The process:
+
+1. forces the CPU platform at the spec's mesh width (a serving worker must
+   never steal the accelerator a training gang holds unless the spec says
+   so), builds a :class:`~harp_tpu.session.HarpSession`, and constructs the
+   endpoints for every model the placement assigns to this rank from the
+   spec's DETERMINISTIC model builders (``fleet.build_endpoint`` — seeded
+   factor generators, so any process can regenerate any epoch's canonical
+   table bit-identically);
+2. ``--restore`` (the SPARE path): top-k endpoints are constructed with
+   ZEROED user factors and re-materialized through the on-device reshard
+   engine — :meth:`TopKEndpoint.restore_full` moves the canonical rows
+   onto the mesh in chunk-bounded rounds and stamps ``--version`` so the
+   spare rejoins announcing the factor epoch it restored;
+3. starts a :class:`~harp_tpu.serve.router.ServeWorker` with
+   ``fault_exit=True`` — the serving chaos grammar
+   (``HARP_FAULT=kill|vanish@request=N:rank=R``) exits with the
+   classification code the fleet supervisor maps to CRASH/VANISH — and an
+   ``on_control`` hook that serves live-refresh pushes
+   (``{"op": "refresh", "version": V}`` regenerates epoch V's factors and
+   ``push_epoch``\\ s them on a side thread while traffic keeps flowing);
+4. publishes its address atomically into the rendezvous directory
+   (``w<rank>.g<generation>.json``) and keeps re-reading the directory so
+   late or replaced peers get dialed;
+5. serves until the controller drops the ``stop`` file, then drains
+   cleanly and exits 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _force_cpu(mesh_workers: int) -> None:
+    # must run before jax initializes a backend (trace_targets idiom)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={mesh_workers}")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", False)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="harp_tpu.serve.worker")
+    p.add_argument("--spec", required=True, help="fleet spec JSON path")
+    p.add_argument("--rank", type=int, required=True)
+    p.add_argument("--generation", type=int, default=0)
+    p.add_argument("--version", type=int, default=0,
+                   help="factor epoch to serve (and restore, with "
+                        "--restore)")
+    p.add_argument("--restore", action="store_true",
+                   help="spare path: zero-build the top-k stores, then "
+                        "restore them through the on-device reshard engine")
+    args = p.parse_args(argv)
+    with open(args.spec) as f:
+        spec = json.load(f)
+    _force_cpu(int(spec.get("mesh_workers", 2)))
+
+    from harp_tpu.serve import fleet as fleet_mod
+    from harp_tpu.serve.cache import TopKReplyCache
+    from harp_tpu.serve.endpoints import TopKEndpoint
+    from harp_tpu.serve.router import ServeWorker
+    from harp_tpu.session import HarpSession
+
+    rank = args.rank
+    session = HarpSession(num_workers=int(spec.get("mesh_workers", 2)))
+    placement = {str(m): int(r) for m, r in spec["placement"].items()}
+    endpoints = {}
+    for name, mspec in spec["models"].items():
+        if placement.get(name) != rank:
+            continue
+        endpoints[name] = fleet_mod.build_endpoint(
+            session, name, mspec, version=args.version,
+            restore=args.restore)
+
+    slo = None
+    if spec.get("slo_p99_s"):
+        from harp_tpu.telemetry.watchdog import SLOWatchdog
+
+        slo = SLOWatchdog(float(spec["slo_p99_s"]), rank=rank,
+                          telemetry_dir=spec.get("telemetry_dir"),
+                          **(spec.get("slo_kw") or {}))
+    cache = TopKReplyCache() if spec.get("cache") else None
+
+    def on_control(frame: dict) -> None:
+        if frame.get("op") != "refresh":
+            return
+        version = int(frame["version"])
+
+        def _apply():
+            # push_epoch's monotonic-version guard makes concurrent
+            # refresh threads safe: if a newer epoch's build wins the
+            # race, the older push is discarded at the swap, never
+            # applied over it
+            try:
+                for name, ep in endpoints.items():
+                    if isinstance(ep, TopKEndpoint):
+                        uf, items = fleet_mod.topk_factors(
+                            spec["models"][name], version)
+                        ep.push_epoch(uf, items, version=version)
+            except (ValueError, RuntimeError):
+                import logging
+
+                logging.getLogger("harp_tpu.serve").exception(
+                    "refresh to version %s failed", version)
+
+        # side thread: push_epoch builds the replacement state off-lock,
+        # so traffic keeps being served by the old epoch while it lands
+        import threading
+
+        threading.Thread(target=_apply, daemon=True,
+                         name=f"harp-serve-refresh-{rank}").start()
+
+    worker = ServeWorker(
+        session, rank, endpoints, placement,
+        peers={}, secret=bytes.fromhex(spec["secret"]),
+        max_wait_s=float(spec.get("max_wait_s", 0.002)),
+        slo=slo, cache=cache, fault_exit=True, on_control=on_control)
+
+    rdv_dir = spec["rendezvous_dir"]
+    my_file = os.path.join(rdv_dir, f"w{rank}.g{args.generation}.json")
+    tmp = my_file + f".tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"rank": rank, "generation": args.generation,
+                   "host": worker.address[0], "port": worker.address[1],
+                   "pid": os.getpid(), "version": args.version}, f)
+    os.replace(tmp, my_file)
+
+    stop_file = os.path.join(rdv_dir, "stop")
+    dialed = {}
+    try:
+        while not os.path.exists(stop_file):
+            # keep the peer map fresh: newest generation per rank wins (a
+            # replaced peer publishes a new file; add_peer drops the stale
+            # pooled connection when the address changed)
+            for peer_rank, addr, gen in fleet_mod.read_rendezvous(rdv_dir):
+                if peer_rank != rank and dialed.get(peer_rank, -1) < gen:
+                    worker.transport.add_peer(peer_rank, addr)
+                    dialed[peer_rank] = gen
+            time.sleep(0.1)
+    finally:
+        worker.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

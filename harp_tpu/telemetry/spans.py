@@ -166,6 +166,17 @@ def breakdown(tr: Dict) -> Optional[Dict]:
     }
     if fwd is not None:
         out["forward_hop_s"] = recv_last - fwd
+    # multi-host clock-skew bound (ISSUE 14): stamps are wall-clock, so on
+    # a cross-host hop the skew lands entirely in the two hop stages. A
+    # NEGATIVE hop duration is impossible on a true timeline — its
+    # magnitude is therefore a per-span LOWER BOUND on the client↔worker
+    # clock offset, surfaced here (and counted by observe_span) so the
+    # fleet can check its NTP story against live traffic instead of
+    # trusting it. The partition identity is preserved (nothing is
+    # clamped): total still equals the stage sum exactly.
+    skew_lb = max(0.0, -out["submit_hop_s"], -out["reply_hop_s"])
+    if skew_lb > 0.0:
+        out["clock_skew_lb_s"] = skew_lb
     return out
 
 
@@ -179,6 +190,12 @@ def observe_span(bd: Dict, metrics) -> None:
     metrics.count("serve.spans")
     if bd["forwarded"]:
         metrics.count("serve.spans_forwarded")
+    if bd.get("clock_skew_lb_s"):
+        # a cross-host span whose hop went negative: the gang's clocks are
+        # at least this far apart — the fleet's NTP bound is violated when
+        # this grows past it
+        metrics.count("serve.spans_skewed")
+        metrics.observe("serve.span.clock_skew_lb", bd["clock_skew_lb_s"])
 
 
 def record_span(bd: Dict, *, extra: Optional[Dict] = None) -> None:

@@ -55,6 +55,28 @@ DEFAULT_MIN_SAMPLES = 20
 INCIDENTS_NAME = "slo_incidents.jsonl"
 TRIGGER_NAME = "xprof_request.json"     # xprof.XprofController's file path
 
+# The machine-readable incident contract (ISSUE 14 satellite): every
+# journaled slo-burn record carries AT LEAST these fields, typed as noted —
+# the serving re-placement policy consumes rank/p99_s/window_s directly
+# (serve.endpoints.rebalance_from_incidents), so the schema is pinned by a
+# test, not by convention. Extending the record is fine; dropping or
+# retyping one of these is a consumer-breaking change.
+INCIDENT_SCHEMA_VERSION = 1
+INCIDENT_REQUIRED_FIELDS = {
+    "v": int,                  # INCIDENT_SCHEMA_VERSION
+    "kind": str,               # "slo-burn"
+    "ts": (int, float),        # wall clock at fire time
+    "rank": int,               # the rank whose watchdog burned
+    "incident": int,           # per-watchdog incident ordinal (1-based)
+    "p99_s": (int, float),     # observed rolling p99 at fire time
+    "p99_target_s": (int, float),
+    "error_fraction": (int, float),
+    "error_budget": (int, float),
+    "window_s": (int, float),  # the rolling-window width evaluated
+    "samples": int,            # window occupancy at fire time
+    "triggered": list,         # which PR 7 machinery fired
+}
+
 
 class SLOWatchdog:
     """Rolling p99-target + error-budget evaluator (module docstring).
@@ -237,6 +259,26 @@ class SLOWatchdog:
         except OSError as e:
             incident["journal_error"] = str(e)
 
+    # -- incident-stream readers (the re-placement consumer surface) --------
+
+    @staticmethod
+    def validate_incident(incident: dict) -> list:
+        """Schema-check one incident record against
+        :data:`INCIDENT_REQUIRED_FIELDS`; returns the list of violations
+        (empty = conformant). The journal writer and the re-placement
+        consumer share this one definition, so they cannot drift apart
+        silently."""
+        problems = []
+        for field, types in INCIDENT_REQUIRED_FIELDS.items():
+            if field not in incident:
+                problems.append(f"missing field {field!r}")
+            elif incident[field] is not None \
+                    and not isinstance(incident[field], types):
+                problems.append(
+                    f"field {field!r} is {type(incident[field]).__name__}, "
+                    f"want {types}")
+        return problems
+
     # -- training-gang adapter ----------------------------------------------
 
     def boundary_hook(self):
@@ -264,3 +306,50 @@ class SLOWatchdog:
 
         hook.close = lambda: None
         return hook
+
+
+def read_incidents(telemetry_dir: str,
+                   max_age_s: Optional[float] = None) -> list:
+    """Parse the SLO incident journal (``slo_incidents.jsonl``) — every
+    watchdog in the gang appends to the same file, so this is the GANG's
+    incident stream, in append order. A torn/undecodable line is skipped
+    (the journal is append-only under concurrent writers; a reader must
+    survive the seam), and ``max_age_s`` drops records older than the
+    bound — a dead gang's stale incidents earn no placement change, the
+    same trust rule the straggler-report readers apply."""
+    path = os.path.join(telemetry_dir, INCIDENTS_NAME)
+    out = []
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return out
+    now = time.time()
+    for line in lines:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(rec, dict) or rec.get("kind") != "slo-burn":
+            continue
+        if max_age_s is not None:
+            ts = rec.get("ts")
+            if not isinstance(ts, (int, float)) or now - ts > max_age_s:
+                continue
+        out.append(rec)
+    return out
+
+
+def incident_ranks(telemetry_dir: str, world: Optional[int] = None,
+                   max_age_s: Optional[float] = 600.0) -> list:
+    """Ranks the fresh SLO incident stream names — the serving analog of
+    :func:`harp_tpu.parallel.supervisor.straggler_ranks`, and the feed the
+    ISSUE 14 re-placement path consumes (``rank``/``p99_s``/``window_s``
+    are schema-pinned, INCIDENT_REQUIRED_FIELDS). Bounded to ``world``
+    when given; sorted, deduplicated."""
+    ranks = set()
+    for rec in read_incidents(telemetry_dir, max_age_s=max_age_s):
+        r = rec.get("rank")
+        if isinstance(r, int) and (world is None or 0 <= r < world):
+            ranks.add(r)
+    return sorted(ranks)

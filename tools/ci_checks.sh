@@ -73,6 +73,17 @@
 #   5. tier-1       — the ROADMAP.md verify suite (which itself re-runs
 #                     jaxlint's clean-repo + budget checks as tests, so
 #                     DOTS_PASSED captures them).
+#   6. serving chaos — ISSUE 14: a scripted kill-under-load on the
+#                     in-process serving gang (HARP_FAULT=kill@request=N
+#                     through the serving fault grammar): the LocalFleet
+#                     supervisor must replace the dead worker, restore
+#                     its shard through the on-device reshard engine,
+#                     re-route the placement, and the retrying client
+#                     must lose ZERO requests. Note the serve_* trace
+#                     targets are re-verified byte-identical with the
+#                     versioned-swap (push_epoch) code in place by
+#                     stages 1-2: version state is host-side only and
+#                     never enters a traced dispatch.
 #
 # Any stage failing fails the script; all stages always run (a lint
 # finding must not hide a test regression or vice versa).
@@ -81,15 +92,15 @@ set -u
 cd "$(dirname "$0")/.."
 rc=0
 
-echo "== [1/5] jaxlint (AST + JL3xx concurrency + jaxpr + gang budgets) =="
+echo "== [1/6] jaxlint (AST + JL3xx concurrency + jaxpr + gang budgets) =="
 python -m tools.jaxlint || rc=1
 
-echo "== [2/5] jaxlint budget with telemetry + request tracing ON (zero drift) =="
+echo "== [2/6] jaxlint budget with telemetry + request tracing ON (zero drift) =="
 tele_dir="$(mktemp -d /tmp/_tele_gate.XXXXXX)"
 HARP_TELEMETRY_DIR="$tele_dir" HARP_TRACE_REQUESTS=1 \
     python -m tools.jaxlint --jaxpr-only || rc=1
 
-echo "== [3/5] gang-mode collective budgets (virtual multi-process mesh) =="
+echo "== [3/6] gang-mode collective budgets (virtual multi-process mesh) =="
 # ISSUE 13: the dryrun_multichip gang-mode step programs traced on the
 # virtual 2-host x 4-device mesh with the workers axis hinted DCN —
 # counts, per-process shard shapes, and the DCN/ICI link-class byte split
@@ -100,10 +111,10 @@ echo "== [3/5] gang-mode collective budgets (virtual multi-process mesh) =="
 # its own stage banner in CI output instead of buried in stage 1's.
 python -m tools.jaxlint --gang-only || rc=1
 
-echo "== [4/5] check_claims =="
+echo "== [4/6] check_claims =="
 python tools/check_claims.py || rc=1
 
-echo "== [5/5] tier-1 tests =="
+echo "== [5/6] tier-1 tests =="
 set -o pipefail
 t1_log="$(mktemp /tmp/_t1.XXXXXX.log)"   # unique per run: concurrent CI
 trap 'rm -f "$t1_log"; rm -rf "$tele_dir"' EXIT   # must not clobber the count
@@ -112,6 +123,11 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -p no:xdist -p no:randomly 2>&1 | tee "$t1_log" || rc=1
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$t1_log" \
     | tr -cd . | wc -c)"
+
+echo "== [6/6] serving-chaos smoke (scripted kill under load, zero failures) =="
+# bounded like stage 5: a wedged recovery (the exact machinery this smoke
+# exercises) must fail CI, never hang it
+timeout -k 10 300 python -m tools.serving_chaos_smoke || rc=1
 
 if [ "$rc" -ne 0 ]; then
     echo "ci_checks: FAILED"

@@ -119,6 +119,16 @@ ALLOWLIST: Allowlist = {
         "timeout, transport reset) must be counted into the row's errors "
         "field and the mix kept running — a dying generator would turn a "
         "server-side error into a missing measurement",
+    ("harp_tpu/benchmark/serving_fleet.py", "client_loop", "JL105"):
+        "fleet chaos-scenario load threads (recovery/refresh): the row's "
+        "ZERO-failures acceptance IS the tally of these catches — any "
+        "per-request failure past the retry layer must land in the "
+        "errors field, and a dying generator would hide exactly the "
+        "failed request the scenario exists to count",
+    ("harp_tpu/benchmark/serving_fleet.py", "loop", "JL105"):
+        "hot-key pass load thread: same zero-failures tally contract as "
+        "client_loop — per-request failures are the measurement, not a "
+        "crash",
     ("harp_tpu/serve/batcher.py", "_dispatch", "JL105"):
         "a malformed query payload in a coalesced serving batch can raise "
         "anything from dtype casts to shape errors deep in the dispatch; "

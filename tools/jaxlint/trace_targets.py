@@ -220,6 +220,104 @@ def _serve_topk_rebalanced():
     return fn, args
 
 
+def _multiclass_svm_pairs():
+    """The multiclass one-vs-one TRAINING program: all pair machines in one
+    vmapped rotation-blocked kernel-dual program (KernelSVM.
+    _fit_padded_pairs builds exactly this spmd: pairs on the vmap batch
+    axis, rows sharded over workers on axis 1) at a 3-class tier-1 shape
+    — the r8 dryrun leg's step program, now budget-pinned (ISSUE 14
+    satellite)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from harp_tpu.models import svm as svm_mod
+
+    sess = _session()
+    cfg = svm_mod.KernelSVMConfig(kernel="rbf", iterations=3, power_iters=2)
+    p, n_pad, d = 3, 64, 6              # 3 classes -> 3 pair machines
+    fn = sess.spmd(
+        jax.vmap(lambda a, t, c: svm_mod._train_kernel_dual(a, t, c, cfg)),
+        in_specs=(sess.shard(1),) * 3,
+        out_specs=(sess.shard(1), sess.replicate(), sess.replicate()))
+    rng = _rng()
+    xb = rng.normal(size=(p, n_pad, d)).astype("float32")
+    yb = np.sign(rng.normal(size=(p, n_pad))).astype("float32")
+    cb = np.full((p, n_pad), cfg.c, "float32")
+    return fn, (sess.scatter(jnp.asarray(xb), axis=1),
+                sess.scatter(jnp.asarray(yb), axis=1),
+                sess.scatter(jnp.asarray(cb), axis=1))
+
+
+def _distributed_sort():
+    """The r10 sort/quantiles dryrun leg's heavy program: the distributed
+    odd-even block sort (sharded output assembled by fetch) at the tier-1
+    shape — its ppermute ladder is exactly the cross-worker traffic the
+    gang rows exist to price."""
+    import jax.numpy as jnp
+
+    from harp_tpu.models import stats as stats_mod
+    from harp_tpu.ops import linalg
+
+    sess = _session()
+    s = stats_mod.Sorting(sess)
+    fn = s._compile("sort", lambda a: linalg.distributed_sort(a), 0,
+                    extra_sharded_out=1)
+    x = _rng().standard_normal((128, 6)).astype("float32")
+    return fn, (sess.scatter(jnp.asarray(x)),)
+
+
+def _csr_cov():
+    """The r10 CSR covariance/PCA dryrun leg's step program: the blocked
+    densify-GEMM gram from CSR input over the mesh (sparse_gram_stats) —
+    CSRPCA rides the same program plus a replicated eigensolve."""
+    from harp_tpu.io import datagen
+    from harp_tpu.models import sparse as sp
+
+    sess = _session()
+    n, dim = 128, 12
+    rows, cols, vals = datagen.sparse_points(n, dim, 0.2, seed=9)
+    cov = sp.CSRCovariance(sess)
+    idx, val, mask, real = cov._layout(rows, cols, vals, n, dim)
+    cov._stats(rows, cols, vals, n, dim)     # populate the compile cache
+    fn = cov._fns[(idx.shape, dim)]
+    return fn, (sess.scatter(idx), sess.scatter(val), sess.scatter(mask),
+                sess.scatter(real))
+
+
+def _kmeans_fileload():
+    """The r11 file-load dryrun leg: K-means fed from part-files on disk
+    through the io/loaders pipeline (list_files glob -> split -> threaded
+    CSV load -> scatter). Pinning it as its own gang row asserts the
+    ingestion path feeds the SAME step program as the in-memory twin —
+    bytes identical, or the leg's bitwise-parity promise broke."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from harp_tpu.io import datagen, loaders
+    from harp_tpu.models import kmeans as km
+
+    sess = _session()
+    io_dir = tempfile.mkdtemp(prefix="harp-lint-io-")
+    try:
+        pts = datagen.dense_points(64, 16, seed=11, num_clusters=8)
+        for i, part in enumerate(np.array_split(pts, 4)):
+            np.savetxt(os.path.join(io_dir, f"part-{i:05d}.csv"), part,
+                       delimiter=",", fmt="%.8e")
+        paths = loaders.list_files(os.path.join(io_dir, "part-*"))
+        splits = loaders.split_files(paths, 2)
+        loaded = loaders.load_dense_csv([p for s in splits for p in s])
+        loaded = loaders.truncate_to_workers(loaded, NUM_WORKERS)
+    finally:
+        shutil.rmtree(io_dir, ignore_errors=True)
+    model = km.KMeans(sess, km.KMeansConfig(8, 16, iterations=2,
+                                            comm="regroupallgather"))
+    p, c = model.prepare(loaded, loaded[:8].copy())
+    return model._fit, (p, c)
+
+
 def _reshard(schedule: str):
     def build():
         import numpy as np
@@ -352,4 +450,14 @@ GANG_TARGETS: Dict[str, Callable[[], Tuple[Callable, tuple]]] = {
     "gang2x4_kmeans_rotation": _kmeans("rotation"),
     "gang2x4_sgd_mf_dense": _sgd_mf(),
     "gang2x4_lda_cgs": _lda(),
+    # ISSUE 14 satellite — the dryrun legs that landed without gang rows
+    # (ROADMAP: "new gang workloads should add gang rows as they land"):
+    # multiclass one-vs-one SVM (r8), distributed sort (the r10
+    # sort/quantiles leg's comm-heavy half), CSR covariance (the r10
+    # cov/PCA leg's step program), and the file-load leg's K-means step
+    # (pins that the ingestion pipeline feeds a byte-identical program).
+    "gang2x4_multiclass_svm_pairs": _multiclass_svm_pairs,
+    "gang2x4_distributed_sort": _distributed_sort,
+    "gang2x4_csr_cov": _csr_cov,
+    "gang2x4_kmeans_fileload": _kmeans_fileload,
 }

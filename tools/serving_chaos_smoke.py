@@ -1,0 +1,86 @@
+"""CI serving-chaos smoke (ISSUE 14 satellite): scripted kill-under-load
+on the in-process serving gang, asserting ZERO failed requests after the
+retry layer rides out the recovery.
+
+The scenario is entirely grammar-driven — ``HARP_FAULT=kill@request=N``
+kills serving rank 0 abruptly mid-traffic (transport torn down, in-flight
+requests dropped), the LocalFleet supervisor replaces the worker, restores
+the top-k shard through the on-device reshard engine, pushes the versioned
+placement, and the retrying client must lose NOTHING and read only
+correct answers. Exit 0 = contract held; any failed or wrong request, or
+a missing journal step, is a non-zero exit for ci_checks.sh.
+
+Run: ``python -m tools.serving_chaos_smoke`` (stage 6 of ci_checks.sh).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    from tools.jaxlint.trace_targets import ensure_cpu_mesh
+
+    ensure_cpu_mesh()
+    import numpy as np
+
+    from harp_tpu.serve import OP_TOPK, TopKEndpoint, local_gang
+    from harp_tpu.serve import fleet as fleet_mod
+    from harp_tpu.session import HarpSession
+
+    sess = HarpSession(num_workers=8)
+    rng = np.random.default_rng(0)
+    uf = rng.normal(size=(64, 8)).astype(np.float32)
+    items = rng.normal(size=(32, 8)).astype(np.float32)
+    ref = fleet_mod.topk_reference(uf, items, 3)
+    ep = TopKEndpoint(sess, "mf", uf, items, k=3)
+    workers, make_client = local_gang(sess, [{"mf": ep}, {}])
+    fleet = fleet_mod.LocalFleet(workers, make_client,
+                                 canonical={"mf": uf})
+    client = fleet.make_client()
+    failures = []
+    try:
+        # warm the dispatch, then arm the scripted kill mid-traffic
+        client.request_retry(OP_TOPK, "mf", 0, timeout=60.0)
+        os.environ["HARP_FAULT"] = "kill@request=10:rank=0"
+        try:
+            for i in range(50):
+                u = i % 64
+                try:
+                    res = client.request_retry(
+                        OP_TOPK, "mf", u, timeout=10.0, attempts=10,
+                        backoff_max_s=0.5, sync_timeout=2.0)
+                    if res["items"] != ref[u]:
+                        failures.append((u, "wrong", res["items"]))
+                except Exception as e:  # noqa: BLE001 — the tally IS the gate
+                    failures.append((u, type(e).__name__, str(e)[:120]))
+        finally:
+            os.environ.pop("HARP_FAULT", None)
+        events = [r["event"] for r in fleet.journal.records]
+        if failures:
+            print(f"serving_chaos_smoke: FAILED — {len(failures)} "
+                  f"failed/wrong request(s): {failures[:5]}")
+            return 1
+        if "worker-death" not in events or "replaced" not in events:
+            print(f"serving_chaos_smoke: FAILED — recovery did not run "
+                  f"(journal: {events}); was the kill injected?")
+            return 1
+        replaced = next(r for r in fleet.journal.records
+                        if r["event"] == "replaced")
+        if replaced.get("restored_rows", {}).get("mf") != len(uf):
+            print(f"serving_chaos_smoke: FAILED — shard restore did not "
+                  f"run through the engine: {replaced}")
+            return 1
+        print(f"serving_chaos_smoke: OK — 50/50 requests answered "
+              f"correctly across a scripted worker kill (journal: "
+              f"{events}, restored {replaced['restored_rows']['mf']} "
+              f"rows, placement v{replaced['placement_version']})")
+        return 0
+    finally:
+        client.close()
+        fleet.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
