@@ -1012,11 +1012,21 @@ def tpu_serving_fleet(small=False):
     return {
         "recovery": serving_fleet.measure_recovery(
             requests_per_client=60 if small else 120),
+        # ISSUE 15: the SAME scripted kill with a pre-warmed artifact
+        # store — the elastic replacement loads every dispatch instead of
+        # compiling (its post-mortem trace_counts ride the row), plus the
+        # rolling-restart cold-start comparison (spawn -> first reply,
+        # artifacts off vs on, with the worker's published stage split)
+        "recovery_aot": serving_fleet.measure_recovery(
+            requests_per_client=60 if small else 120,
+            prebuild_artifacts=True),
         "refresh": serving_fleet.measure_refresh(
             sess, requests_per_client=100 if small else 200),
         "hotkey": serving_fleet.measure_hotkey(
             sess, requests_per_client=150 if small else 400,
             zipf_alpha=1.2),
+        "restart": serving_fleet.measure_restart(
+            repeats=2 if small else 3),
     }
 
 
@@ -1574,13 +1584,22 @@ def main():
         detail["serving_fleet"] = frow
         if isinstance(frow, dict) and "recovery" in frow:
             rec_row = frow["recovery"]
+            rec_aot = frow.get("recovery_aot", {})
             ref_row = frow.get("refresh", {})
             hot_row = frow.get("hotkey", {})
+            rst_row = frow.get("restart", {})
             compact.update({
                 "fleet_recovery_errors": rec_row.get("errors"),
                 "fleet_recovery_s": rec_row.get("observed_recovery_s"),
                 "fleet_recovery_p99_blip_ms":
                     (rec_row.get("recovery_window") or {}).get("p99_ms"),
+                "fleet_recovery_aot_s": rec_aot.get("observed_recovery_s"),
+                "restart_to_first_reply_s":
+                    (rst_row.get("no_aot") or {}).get(
+                        "restart_to_first_reply_s"),
+                "restart_to_first_reply_aot_s":
+                    (rst_row.get("aot") or {}).get(
+                        "restart_to_first_reply_s"),
                 "fleet_refresh_torn_reads": ref_row.get("torn_reads"),
                 "fleet_refresh_errors": ref_row.get("errors"),
                 "fleet_hotkey_hit_rate":

@@ -1,0 +1,73 @@
+"""jax persistent compilation cache — one-call wiring (ISSUE 15 satellite).
+
+Distinct from and composable with the export-artifact path: an export
+artifact kills the TRACE (the Python body never runs on load), but its
+shipped StableHLO still XLA-compiles once per process; the persistent
+compilation cache turns that compile — and every other compile the process
+performs, artifact-backed or not — into a disk load. A fleet pointing every
+worker's ``--compile-cache-dir`` at shared storage pays each distinct
+program's compile exactly once, fleet-wide.
+
+jax gates cache writes on minimum compile time / entry size by default
+(tuned for large programs); serving dispatches at tier-1 shapes compile in
+milliseconds, so :func:`enable_compile_cache` zeroes both floors — the
+point here is cold-start latency, not disk economy.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+LOG = logging.getLogger("harp_tpu.aot")
+
+_enabled_dir: Optional[str] = None
+
+
+def enable_compile_cache(directory: Optional[str]) -> bool:
+    """Point jax's persistent compilation cache at ``directory`` (created
+    if missing). Returns whether the cache is active. ``None``/empty is a
+    no-op returning False — every CLI flag funnels through here, unset
+    included. Idempotent; a second call with a DIFFERENT directory
+    re-points the cache (jax re-reads the config per compile)."""
+    global _enabled_dir
+    if not directory:
+        return False
+    import os
+
+    import jax
+
+    os.makedirs(directory, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", directory)
+    # zero the write floors: serving dispatches are small and fast to
+    # compile — exactly the programs a cold start pays for one by one
+    for knob, value in (
+            ("jax_persistent_cache_min_compile_time_secs", 0.0),
+            ("jax_persistent_cache_min_entry_size_bytes", 0)):
+        try:
+            jax.config.update(knob, value)
+        except AttributeError:
+            # an older/newer jax without this knob: the cache still works
+            # at its default floor — log once, keep going
+            LOG.info("compile cache: config %s unavailable on jax %s",
+                     knob, jax.__version__)
+    # jax latches its cache decision at the FIRST compile of the process
+    # (sticky _cache_initialized/_cache_checked flags): a process that
+    # already compiled anything before this call — a serving worker
+    # enabling the cache at ctor time inside a long-lived controller —
+    # would silently keep the cache off without this reset
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except (ImportError, AttributeError):
+        LOG.info("compile cache: reset_cache unavailable on jax %s — "
+                 "cache activates only if nothing compiled yet",
+                 jax.__version__)
+    _enabled_dir = directory
+    return True
+
+
+def active_dir() -> Optional[str]:
+    """The directory the cache was last enabled at (None = never)."""
+    return _enabled_dir

@@ -1,0 +1,209 @@
+"""Pinned compiled-program manifest — ``tools/artifact_manifest.json``.
+
+The collective-budget idea applied to whole programs (ISSUE 15): the
+budget manifest pins what a step program COMMUNICATES; this manifest pins
+what the exported program IS. Every registry target below is exported at
+tier-1 shapes on the 8-worker virtual CPU mesh and content-hashed over its
+lowered StableHLO module text (deterministic per jax version/platform —
+verified cross-process). jaxlint checks the hashes the way it checks byte
+budgets: a silently changed compiled program — a dispatch gaining an op, a
+sharding drift, an optimization barrier appearing — is a CI finding naming
+the target, and ``--update-artifacts`` regenerates the manifest so the
+change is COMMITTED deliberately, diff-reviewed like a budget row.
+
+Registry: the serving dispatches of the fleet's deterministic tier-1
+models (every bucket of the top-k and classify endpoints — the exact
+programs ``aot warm`` ships and a spare loads) plus two model STEP
+programs (K-means regroupallgather, SGD-MF dense rotation) exported
+through the same store path — the "step programs as artifacts" half of the
+tentpole, pinned at the same shapes the budget manifest traces.
+
+The manifest also records the jax version / device kind / world it was
+pinned under; a checker running anywhere else reports ONE clear re-pin
+finding instead of N bogus hash drifts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Dict, List, Tuple
+
+from harp_tpu.aot import serve_artifacts
+from harp_tpu.aot.store import ArtifactStore, layout_of
+
+MANIFEST_REL = os.path.join("tools", "artifact_manifest.json")
+NUM_WORKERS = 8                # the tier-1 virtual mesh (conftest/jaxlint)
+
+# the fleet-shaped deterministic serving models (same tier-1 shapes the
+# serving_fleet bench and chaos smoke run): spec IS model identity
+SERVE_MODELS: Dict[str, dict] = {
+    "mf": {"kind": "topk", "num_users": 64, "num_items": 32, "rank": 8,
+           "k": 3, "seed": 7},
+    "nn": {"kind": "classify_nn", "dim": 12, "classes": 3, "layers": [8],
+           "seed": 1},
+}
+
+
+def _session():
+    from harp_tpu.session import HarpSession
+
+    return HarpSession(num_workers=NUM_WORKERS)
+
+
+def _rng():
+    import numpy as np
+
+    return np.random.default_rng(0)
+
+
+def _step_kmeans() -> Tuple[Callable, tuple]:
+    from harp_tpu.models import kmeans as km
+
+    sess = _session()
+    model = km.KMeans(sess, km.KMeansConfig(8, 16, iterations=2,
+                                            comm="regroupallgather"))
+    pts = _rng().normal(size=(64, 16)).astype("float32")
+    p, c = model.prepare(pts, pts[:8].copy())
+    return model._fit, (p, c)
+
+
+def _step_sgd_mf() -> Tuple[Callable, tuple]:
+    from harp_tpu.models import sgd_mf
+
+    sess = _session()
+    cfg = sgd_mf.SGDMFConfig(rank=8, lam=0.01, lr=0.1, epochs=2,
+                             minibatches_per_hop=2)
+    model = sgd_mf.SGDMF(sess, cfg)
+    rng = _rng()
+    n = 400
+    rows = rng.integers(0, 64, size=n)
+    cols = rng.integers(0, 48, size=n)
+    vals = rng.normal(size=n).astype("float32")
+    layout, data, w0, h0, meta = model.prepare(rows, cols, vals, 64, 48)
+    key = model._program(layout, cfg.minibatches_per_hop, cfg.epochs,
+                         meta[6])
+    return model._compiled[key], (*data, w0, h0)
+
+STEP_PROGRAMS: Dict[str, Callable] = {
+    "step/kmeans_regroupallgather": _step_kmeans,
+    "step/sgd_mf_dense": _step_sgd_mf,
+}
+
+
+def manifest_path(root: str) -> str:
+    return os.path.join(root, MANIFEST_REL)
+
+
+def export_registry(store: ArtifactStore) -> Dict[str, dict]:
+    """Export every registry target into ``store``; returns
+    ``{name: meta}`` — the rows the manifest pins. Serving endpoints are
+    built from their deterministic specs (every bucket exported); step
+    programs export their prepared compiled fn + placed args."""
+    from harp_tpu.serve import fleet as fleet_mod
+
+    out: Dict[str, dict] = {}
+    sess = _session()
+    for model, mspec in SERVE_MODELS.items():
+        ep = fleet_mod.build_endpoint(sess, model, mspec)
+        metas = serve_artifacts.export_endpoint(
+            store, ep,
+            model_hash=serve_artifacts.model_hash_from_spec(mspec))
+        for bucket, meta in metas.items():
+            out[serve_artifacts.dispatch_name(model, bucket)] = meta
+    for name, build in STEP_PROGRAMS.items():
+        fn, args = build()
+        from harp_tpu.aot.store import ArtifactKey
+
+        key = ArtifactKey(name=name, world=NUM_WORKERS,
+                          layout=layout_of(args),
+                          model_hash=serve_artifacts.model_hash_from_spec(
+                              {"step": name}))
+        out[name] = store.export_and_put(key, fn, args)
+    return out
+
+
+def build_rows(workdir: str) -> Dict[str, dict]:
+    """Export the registry into ``workdir`` and distill the manifest rows
+    (content hash + format + size per target)."""
+    metas = export_registry(ArtifactStore(workdir))
+    return {name: {"content_hash": m["content_hash"],
+                   "format": m["format"],
+                   "payload_bytes": m["payload_bytes"]}
+            for name, m in sorted(metas.items())}
+
+
+def write(root: str, rows: Dict[str, dict]) -> str:
+    from harp_tpu.aot.store import device_kind, jax_version
+
+    path = manifest_path(root)
+    with open(path, "w") as f:
+        json.dump({
+            "_comment": "Pinned compiled-program hashes (harp_tpu/aot/"
+                        "manifest.py registry, tier-1 shapes, 8-worker "
+                        "virtual mesh). content_hash = sha256 of the "
+                        "exported StableHLO module text. Checked by "
+                        "jaxlint; regenerate DELIBERATELY with "
+                        "`python -m tools.jaxlint --update-artifacts`.",
+            "jax_version": jax_version(),
+            "device_kind": device_kind(),
+            "world": NUM_WORKERS,
+            "artifacts": rows,
+        }, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def update(root: str, workdir: str) -> str:
+    return write(root, build_rows(workdir))
+
+
+def check(root: str, workdir: str) -> List[str]:
+    """Re-export the registry and diff against the committed manifest.
+    Returns finding strings (empty = clean): hash drift (the compiled
+    program changed — commit it deliberately via --update-artifacts),
+    unpinned target (registry grew without re-pinning), stale manifest row
+    (registry shrank), or an environment mismatch (ONE re-pin finding)."""
+    from harp_tpu.aot.store import device_kind, jax_version
+
+    path = manifest_path(root)
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except OSError:
+        return [f"artifact manifest missing at {path} — run "
+                f"`python -m tools.jaxlint --update-artifacts`"]
+    env = {"jax_version": jax_version(), "device_kind": device_kind(),
+           "world": NUM_WORKERS}
+    for axis, running in env.items():
+        pinned = manifest.get(axis)
+        if pinned != running:
+            return [
+                f"artifact manifest was pinned under {axis}={pinned!r} "
+                f"but this environment runs {running!r} — exported "
+                f"programs are environment-specific; re-pin with "
+                f"--update-artifacts on the CI environment"]
+    rows = build_rows(workdir)
+    pinned_rows = manifest.get("artifacts", {})
+    findings = []
+    for name, row in rows.items():
+        pin = pinned_rows.get(name)
+        if pin is None:
+            findings.append(
+                f"artifact target {name!r} is not pinned in the manifest "
+                f"— new registry targets must be committed "
+                f"(--update-artifacts)")
+        elif pin.get("content_hash") != row["content_hash"]:
+            findings.append(
+                f"artifact {name!r} compiled-program hash drifted: "
+                f"manifest pins {pin.get('content_hash', '')[:12]}…, "
+                f"freshly exported program hashes "
+                f"{row['content_hash'][:12]}… — the resident program "
+                f"CHANGED; commit it deliberately (--update-artifacts) "
+                f"or find the regression")
+    for name in pinned_rows:
+        if name not in rows:
+            findings.append(
+                f"manifest pins {name!r} but the registry no longer "
+                f"exports it — stale row; --update-artifacts")
+    return findings

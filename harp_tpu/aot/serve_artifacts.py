@@ -1,0 +1,142 @@
+"""Serving-plane glue: export / load / warm resident dispatches.
+
+The serving analog of the store (ISSUE 15 tentpole): every
+(model, bucket) resident dispatch an :class:`~harp_tpu.serve.endpoints.
+Endpoint` holds is one exportable program. ``aot warm`` (run.py) calls
+:func:`export_endpoint` offline; a starting worker calls
+:func:`load_endpoint` — fresh store hits are INSTALLED into the endpoint's
+compiled-fn cache (``Endpoint.install_compiled``), so the first dispatch
+replays shipped StableHLO instead of tracing: ``trace_counts`` stays 0 for
+every loaded bucket, and the endpoint's never-recompile assertion keeps it
+that way under live traffic.
+
+``warm=True`` additionally dispatches each loaded bucket once on an EMPTY
+placed query before returning — the XLA compile of the shipped module (and
+anything the persistent compilation cache serves) happens BEFORE the worker
+rendezvouses, so an elastic replacement's first real request pays a warm
+dispatch, nothing else.
+
+Artifact identity: the store key's ``layout`` axis is derived from the
+actual dispatch signature (``Endpoint.dispatch_args``), so any resident
+reshape — a rebalance's owner-routed layout, a different bucket set, a
+re-sharded state arg — is automatically a different artifact. The
+``model_hash`` axis carries the model identity: fleet workers pass
+:func:`model_hash_from_spec` (the deterministic spec IS the model);
+spec-less endpoints default to a structural hash of the endpoint itself.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Dict, List, Optional
+
+from harp_tpu.aot.store import ArtifactKey, ArtifactStore, layout_of
+
+
+def dispatch_name(model: str, bucket: int, *,
+                  owner_routed: bool = False) -> str:
+    """Store name of one (model, bucket) dispatch. The owner-routed
+    (post-rebalance) program is a different artifact by name AND layout —
+    the suffix keeps the store listing readable."""
+    return f"serve/{model}/b{bucket}" + ("-routed" if owner_routed else "")
+
+
+def model_hash_from_spec(mspec: dict) -> str:
+    """Content hash of a deterministic fleet model spec — every process
+    that regenerates the model from the same spec shares the hash, so the
+    initial worker's artifacts serve every later spare. A changed spec
+    (new shape, new seed, new kind) is a changed model: miss_model_hash."""
+    return hashlib.sha256(
+        json.dumps(mspec, sort_keys=True).encode()).hexdigest()
+
+
+def endpoint_model_hash(ep) -> str:
+    """Structural fallback hash for endpoints built without a spec: the
+    endpoint class, name, bucket set, and its model-shape attributes.
+    Coarser than a spec hash (two different factor TABLES of the same
+    shape share it — the layout axis still matches, and factor values are
+    state, not program), which is exactly right: the artifact is the
+    PROGRAM."""
+    ident = {"class": type(ep).__name__, "name": ep.name,
+             "buckets": list(ep.bucket_sizes)}
+    for attr in ("k", "num_items", "_dim", "dim"):
+        v = getattr(ep, attr, None)
+        if isinstance(v, (int, float)):
+            ident[attr] = v
+    return hashlib.sha256(
+        json.dumps(ident, sort_keys=True).encode()).hexdigest()
+
+
+def _key(ep, bucket: int, args, model_hash: Optional[str]) -> ArtifactKey:
+    return ArtifactKey(
+        name=dispatch_name(ep.name, bucket,
+                           owner_routed=getattr(ep, "_owner_routed", False)),
+        world=ep.session.num_workers,
+        layout=layout_of(args),
+        model_hash=model_hash or endpoint_model_hash(ep))
+
+
+def export_endpoint(store: ArtifactStore, ep, *,
+                    model_hash: Optional[str] = None,
+                    buckets=None) -> Dict[int, dict]:
+    """Export every bucket's resident dispatch into the store (the
+    offline ``aot warm`` path — this TRACES each bucket in the exporting
+    process, which is the whole point: the trace happens here, once, not
+    in every cold worker). Returns ``{bucket: meta}``."""
+    out = {}
+    for bucket in (ep.bucket_sizes if buckets is None else buckets):
+        fn = ep.compiled(bucket)
+        args = ep.dispatch_args(bucket)
+        out[bucket] = store.export_and_put(
+            _key(ep, bucket, args, model_hash), fn, args)
+    return out
+
+
+def load_endpoint(store: ArtifactStore, ep, *,
+                  model_hash: Optional[str] = None, warm: bool = True,
+                  warm_missing: bool = False) -> List[int]:
+    """Install every fresh store hit into the endpoint; returns the loaded
+    buckets (sorted). Misses fall back to the lazy compile path untouched
+    — unless ``warm_missing``, which builds and warms the missed buckets
+    NOW (tracing them — the spare path's "never serve cold" completion:
+    with a populated store nothing misses and nothing traces; with a stale
+    one, the compile still lands before rendezvous instead of under
+    traffic)."""
+    import jax
+
+    loaded = []
+    try:
+        args0 = ep.dispatch_args(ep.bucket_sizes[0])
+    except (NotImplementedError, ValueError) as e:
+        # an endpoint that cannot describe its own dispatch signature (a
+        # ClassifyEndpoint built without dim=, a custom subclass without
+        # _dummy_batch) keeps the lazy compile path it always had — a
+        # worker that served fine without AOT must still start WITH it;
+        # the skip is metered and logged like a store miss
+        store.metrics.count("aot.store.skip_unfingerprintable")
+        import logging
+
+        logging.getLogger("harp_tpu.aot").warning(
+            "endpoint %r cannot build its dispatch signature (%s) — "
+            "AOT load skipped, lazy compile path kept", ep.name, e)
+        return loaded
+    for bucket in ep.bucket_sizes:
+        args = (args0 if bucket == ep.bucket_sizes[0]
+                else ep.dispatch_args(bucket))
+        hit = store.load(_key(ep, bucket, args, model_hash))
+        if hit is None:
+            if warm_missing:
+                jax.block_until_ready(ep.compiled(bucket)(
+                    *ep.dispatch_args(bucket)))
+            continue
+        fn, _meta = hit
+        ep.install_compiled(bucket, fn)
+        loaded.append(bucket)
+        if warm:
+            # one empty-query dispatch: the shipped module's XLA compile
+            # (or compile-cache load) happens here, pre-rendezvous; the
+            # dummy args are rebuilt because the loaded jit holds no
+            # donation contract but the compile-path twin above does
+            jax.block_until_ready(fn(*ep.dispatch_args(bucket)))
+    return loaded

@@ -58,6 +58,34 @@ def _device() -> str:
             else jax.devices()[0].platform)
 
 
+def _warm_subprocess(models: dict, aot_dir: str,
+                     mesh_workers: int = 2) -> float:
+    """Run ``harp_tpu.run aot warm`` in a subprocess (the real offline
+    prebuild path — it forces its own virtual CPU mesh at the fleet's
+    width, which the bench controller's already-initialized backend may
+    not offer). Returns the wall seconds of the whole prebuild step."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    import harp_tpu
+
+    cwd = os.path.dirname(os.path.dirname(os.path.abspath(
+        harp_tpu.__file__)))
+    t0 = time.perf_counter()
+    out = subprocess.run(
+        [sys.executable, "-m", "harp_tpu.run", "aot", "warm",
+         "--aot-dir", aot_dir, "--models-json", json.dumps(models),
+         "--mesh-workers", str(mesh_workers)],
+        cwd=cwd, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=300)
+    if out.returncode != 0:
+        raise RuntimeError(f"aot warm failed rc={out.returncode}:\n"
+                           f"{out.stderr[-800:]}")
+    return time.perf_counter() - t0
+
+
 # --------------------------------------------------------------------------- #
 # Recovery blip (separate-process gang, scripted kill)
 # --------------------------------------------------------------------------- #
@@ -68,13 +96,22 @@ def measure_recovery(*, num_users: int = 64, num_items: int = 32,
                      warmup_per_client: int = 12,
                      kill_at_request: int = 60,
                      request_timeout: float = 15.0,
-                     attempts: int = 12, seed: int = 7) -> dict:
+                     attempts: int = 12, seed: int = 7,
+                     aot_dir: Optional[str] = None,
+                     prebuild_artifacts: bool = False) -> dict:
     """Kill serving rank 1 of a 2-process gang under load (module
     docstring). A concurrent warmup phase first compiles every bucket the
     measured loop can reach in both workers (compile time must not read
     as steady-state latency); ``kill_at_request`` counts rank 1's
-    RECEIVED requests, so it is set past the warmup's share. Returns the
+    RECEIVED requests, so it is set past the warmup's share.
+    ``prebuild_artifacts`` runs the ISSUE 15 leg: ``aot warm`` into
+    ``aot_dir`` (a temp store by default) before the gang starts, so the
+    spare REPLACEMENT loads every dispatch instead of compiling — the
+    row gains the replacement's post-mortem ``trace_counts`` (asserted 0
+    for loaded buckets by the tier-1 twin of this scenario). Returns the
     committed row."""
+    import tempfile
+
     from harp_tpu.serve import OP_CLASSIFY, OP_TOPK
     from harp_tpu.serve import fleet as fleet_mod
 
@@ -84,8 +121,19 @@ def measure_recovery(*, num_users: int = 64, num_items: int = 32,
               "nn": {"kind": "classify_nn", "dim": 12, "classes": 3,
                      "layers": [8], "seed": 1}}
     placement = {"mf": 1, "nn": 0}
+    prebuild_s = None
+    # TemporaryDirectory, not mkdtemp: its finalizer removes the populated
+    # store even when the run raises mid-scenario (a failing bench must
+    # not accumulate /tmp stores), while the explicit cleanup() below
+    # keeps the success path deterministic
+    own_tmp = None
+    if prebuild_artifacts and aot_dir is None:
+        own_tmp = tempfile.TemporaryDirectory(prefix="harp-bench-aot-")
+        aot_dir = own_tmp.name
+    if prebuild_artifacts:
+        prebuild_s = round(_warm_subprocess(models, aot_dir), 3)
     gang = fleet_mod.ProcessServeGang(
-        models, placement,
+        models, placement, aot_dir=aot_dir,
         env_extra={"HARP_FAULT":
                    f"kill@request={kill_at_request}:rank=1"})
     ref = fleet_mod.topk_reference(*fleet_mod.topk_factors(models["mf"],
@@ -155,8 +203,21 @@ def measure_recovery(*, num_users: int = 64, num_items: int = 32,
                       if r.get("event") == "worker-death"), None)
         replaced = next((r for r in gang.journal.records
                          if r.get("event") == "replaced"), None)
+        # the replacement's own start-up stage timings (published with its
+        # rendezvous record): where the recovery window actually went —
+        # jax init vs restore vs compile-or-load (ISSUE 15's target
+        # share). Guarded on the journal AND the record's generation: a
+        # wedged recovery must not commit the DEAD gen-0 worker's stages
+        # under the replacement's name
+        rec1 = fleet_mod.read_worker_records(gang.rdv_dir).get(1, {})
+        replacement_stages = (
+            rec1.get("stages") if replaced is not None
+            and rec1.get("generation") == replaced["generation"] else None)
     finally:
         gang.stop()
+    replacement_status = (fleet_mod.read_status(
+        gang.rdv_dir, 1, int(replaced["generation"]))
+        if replaced else None)
     recovery_s = (round(replaced["ts"] - death["ts"], 3)
                   if death and replaced else None)
     # the OBSERVED recovery window: from the death to the completion of
@@ -195,6 +256,13 @@ def measure_recovery(*, num_users: int = 64, num_items: int = 32,
         "death_cause": death.get("cause") if death else None,
         "restored_version": (replaced or {}).get("restored_version"),
         "journal_events": [r.get("event") for r in gang.journal.records],
+        "aot": bool(aot_dir),
+        "prebuild_s": prebuild_s,
+        "replacement_stages": replacement_stages,
+        "replacement_trace_counts": (replacement_status or {}).get(
+            "trace_counts"),
+        "replacement_aot_loaded": (replacement_status or {}).get(
+            "aot_loaded"),
     }
     if row["device"] != "tpu":
         row["note"] = ("cpu-mesh: recovery window prices subprocess jax "
@@ -202,6 +270,141 @@ def measure_recovery(*, num_users: int = 64, num_items: int = 32,
                        "with CPU dispatches; the driver's on-chip run "
                        "re-measures (AOT artifacts are the ROADMAP's next "
                        "rung for the compile share)")
+    if own_tmp is not None:
+        own_tmp.cleanup()
+    return row
+
+
+# --------------------------------------------------------------------------- #
+# Restart to first reply (rolling-restart cold start, artifacts off vs on)
+# --------------------------------------------------------------------------- #
+
+def measure_restart(*, num_users: int = 64, num_items: int = 32,
+                    rank: int = 8, k: int = 3, repeats: int = 3,
+                    seed: int = 7) -> dict:
+    """``restart_to_first_reply`` (ISSUE 15 acceptance): spawn a fresh
+    1-rank serving gang and time spawn → first successful top-k reply,
+    once with a cold store (every bucket compiles) and once against a
+    pre-warmed artifact store (every bucket loads; all warm-up lands
+    BEFORE rendezvous), plus the composed leg (``aot_cache``): artifacts
+    + the persistent compilation cache, primed by one unmeasured start —
+    export kills the trace, the cache kills the XLA compile of the
+    shipped module. Per-leg medians over ``repeats`` runs, plus the
+    replacement-side stage breakdown (spawn→main / jax init / build /
+    compile-or-load) from the worker's published rendezvous record — the
+    PERF.md recovery-window stage table is THIS data."""
+    import tempfile
+
+    from harp_tpu.serve import OP_TOPK
+    from harp_tpu.serve import fleet as fleet_mod
+
+    models = {"mf": {"kind": "topk", "num_users": num_users,
+                     "num_items": num_items, "rank": rank, "k": k,
+                     "seed": seed}}
+    ref = fleet_mod.topk_reference(*fleet_mod.topk_factors(models["mf"],
+                                                           0), k)
+
+    def one_leg(aot_dir, compile_cache_dir=None, prime: bool = False
+                ) -> dict:
+        totals, stage_rows, first_reply_waits = [], [], []
+        for i in range(repeats + int(prime)):
+            gang = fleet_mod.ProcessServeGang(
+                models, {"mf": 0}, mesh_workers=2, aot_dir=aot_dir,
+                compile_cache_dir=compile_cache_dir)
+            t0 = time.perf_counter()
+            t0_wall = time.time()
+            try:
+                gang.start()
+                t_ready = time.perf_counter()
+                client = gang.make_client()
+                try:
+                    res = client.request_retry(OP_TOPK, "mf", 7,
+                                               timeout=30.0, attempts=5)
+                finally:
+                    client.close()
+                t_reply = time.perf_counter()
+                if res["items"] != ref[7]:
+                    raise RuntimeError(f"cold-start reply wrong: "
+                                       f"{res['items']} != {ref[7]}")
+                stages = (fleet_mod.read_worker_records(gang.rdv_dir)
+                          .get(0, {}).get("stages") or {})
+            finally:
+                gang.stop()
+            if prime and i == 0:
+                continue     # the unmeasured cache-priming start
+            totals.append(t_reply - t0)
+            first_reply_waits.append(t_reply - t_ready)
+            if stages:
+                stages = dict(stages)
+                if stages.get("main_unix_ts"):
+                    stages["spawn_to_main_s"] = round(
+                        stages.pop("main_unix_ts") - t0_wall, 4)
+                stage_rows.append(stages)
+        import statistics
+
+        out = {
+            "restart_to_first_reply_s": round(statistics.median(totals),
+                                              3),
+            "runs_s": [round(t, 3) for t in sorted(totals)],
+            "rendezvous_to_first_reply_s": round(
+                statistics.median(first_reply_waits), 3),
+        }
+        if stage_rows:
+            keys = sorted({k_ for s in stage_rows for k_ in s})
+            out["stages_median_s"] = {
+                k_: round(statistics.median(
+                    s.get(k_, 0.0) for s in stage_rows), 4)
+                for k_ in keys}
+        return out
+
+    import shutil
+
+    aot_dir = tempfile.mkdtemp(prefix="harp-bench-aot-")
+    cache_dir = tempfile.mkdtemp(prefix="harp-bench-cc-")
+    try:
+        prebuild_s = round(_warm_subprocess(models, aot_dir), 3)
+        cold = one_leg(None)
+        warm = one_leg(aot_dir)
+        composed = one_leg(aot_dir, compile_cache_dir=cache_dir,
+                           prime=True)
+    finally:
+        # bench runs must not accumulate populated stores in /tmp
+        shutil.rmtree(aot_dir, ignore_errors=True)
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+    def speed(leg):
+        return (round(cold["restart_to_first_reply_s"]
+                      / leg["restart_to_first_reply_s"], 2)
+                if leg["restart_to_first_reply_s"] else None)
+
+    row = {
+        "gang": f"fresh 1-rank gang (mesh width 2), spawn -> first "
+                f"correct top-k reply, median of {repeats}",
+        "device": _device(),
+        "no_aot": cold,
+        "aot": warm,
+        "aot_cache": composed,
+        "aot_prebuild_s": prebuild_s,
+        "speedup": speed(warm),
+        "speedup_aot_cache": speed(composed),
+        # the traffic-visible cold-start blip: how long a client waits
+        # AFTER the worker announced itself — the artifacts leg serves
+        # warm from its first request (this is the number the recovery
+        # window inherits; total start shifts warm-up earlier by design)
+        "serving_window_speedup": (round(
+            cold["rendezvous_to_first_reply_s"]
+            / warm["rendezvous_to_first_reply_s"], 2)
+            if warm["rendezvous_to_first_reply_s"] else None),
+    }
+    if row["device"] != "tpu":
+        row["note"] = ("cpu-mesh: every leg pays ~1.1s subprocess "
+                       "python+jax import; tier-1-shape CPU compiles are "
+                       "milliseconds, so the artifact win shows in the "
+                       "SERVING WINDOW (rendezvous->first reply: all "
+                       "buckets pre-warmed vs compiled under traffic) "
+                       "rather than total start; on-chip the compile "
+                       "share — and the artifact win — grows, the "
+                       "driver's on-chip run re-measures")
     return row
 
 
@@ -471,11 +674,24 @@ def measure_hotkey(session=None, *, num_users: int = 512,
 
 def measure(session=None, *, recovery_kw: Optional[dict] = None,
             refresh_kw: Optional[dict] = None,
-            hotkey_kw: Optional[dict] = None) -> dict:
-    """All three fleet rows (the ``bench.py --only serving`` extension);
-    per-scenario kwargs forward to their measure_* functions."""
+            hotkey_kw: Optional[dict] = None,
+            restart_kw: Optional[dict] = None) -> dict:
+    """All fleet rows (the ``bench.py --only serving`` extension);
+    per-scenario kwargs forward to their measure_* functions. The ISSUE
+    15 comparison rides as ``restart`` (cold start off/on artifacts) and
+    ``recovery_aot`` (the scripted-kill recovery re-run with a pre-warmed
+    store — the elastic replacement loads instead of compiling)."""
+    base_kw = dict(recovery_kw or {})
+    # the baseline leg must stay artifact-free for the comparison to mean
+    # anything, and the aot leg's override must not collide with a
+    # caller-supplied key
+    base_kw.pop("prebuild_artifacts", None)
+    base_kw.pop("aot_dir", None)
     return {
-        "recovery": measure_recovery(**(recovery_kw or {})),
+        "recovery": measure_recovery(**base_kw),
+        "recovery_aot": measure_recovery(
+            **{**dict(recovery_kw or {}), "prebuild_artifacts": True}),
         "refresh": measure_refresh(session, **(refresh_kw or {})),
         "hotkey": measure_hotkey(session, **(hotkey_kw or {})),
+        "restart": measure_restart(**(restart_kw or {})),
     }

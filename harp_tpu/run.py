@@ -84,6 +84,14 @@ def _common_flags(p: argparse.ArgumentParser) -> None:
                         "straggler-format snapshot, and journals the "
                         "incident under --telemetry-dir. 0 = off; requires "
                         "--telemetry-dir.")
+    p.add_argument("--compile-cache-dir", default="",
+                   help="jax persistent compilation cache directory "
+                        "(harp_tpu.aot.cache): every XLA compile this run "
+                        "performs is written there and every later run — "
+                        "or serving worker/spare pointed at the same dir — "
+                        "loads instead of compiling. Composable with the "
+                        "AOT export artifacts (`aot warm`), which kill the "
+                        "trace; this kills the compile. Empty = off.")
     p.add_argument("--slo-window-s", type=float, default=30.0,
                    help="SLO watchdog rolling-window length, seconds")
     p.add_argument("--slo-error-budget", type=float, default=0.1,
@@ -114,6 +122,10 @@ def _session(args):
         from harp_tpu.parallel import distributed
 
         distributed.initialize()
+    if getattr(args, "compile_cache_dir", ""):
+        from harp_tpu.aot.cache import enable_compile_cache
+
+        enable_compile_cache(args.compile_cache_dir)
     from harp_tpu.session import HarpSession
 
     n = args.num_workers or len(jax.devices())
@@ -1182,7 +1194,102 @@ def run_sgxsimu(argv) -> int:
     return 0
 
 
+def run_aot(argv) -> int:
+    """AOT dispatch artifacts (ISSUE 15): offline prebuild + store tools.
+
+    ``aot warm`` exports every (model, bucket) resident serving dispatch
+    of a fleet's deterministic model specs into ``--aot-dir`` — run it
+    once per deploy (or per jax upgrade / mesh change), point the fleet's
+    ``aot_dir`` at the store, and every worker cold start — initial OR
+    elastic spare — becomes a load: no trace, compile absorbed before
+    rendezvous. ``aot ls`` lists the store; ``aot check`` verifies the
+    pinned compiled-program manifest (the jaxlint --artifacts-only gate).
+    """
+    p = argparse.ArgumentParser(prog="harp_tpu.run aot")
+    p.add_argument("action", choices=["warm", "ls", "check"])
+    p.add_argument("--aot-dir", default="",
+                   help="artifact store directory (warm/ls)")
+    p.add_argument("--spec", default="",
+                   help="fleet spec JSON (a ProcessServeGang workdir's "
+                        "fleet_spec.json) — models + mesh width come from "
+                        "it")
+    p.add_argument("--models-json", default="",
+                   help="inline {model: spec} JSON instead of --spec "
+                        "(fleet.build_endpoint spec shapes)")
+    p.add_argument("--mesh-workers", type=int, default=2,
+                   help="mesh width to export at (must match the serving "
+                        "fleet's; overridden by --spec)")
+    p.add_argument("--version", type=int, default=0,
+                   help="factor epoch to build the endpoints at (the "
+                        "PROGRAM is epoch-independent; this only seeds "
+                        "the throwaway state)")
+    p.add_argument("--compile-cache-dir", default="",
+                   help="also populate the persistent compilation cache "
+                        "while warming")
+    args = p.parse_args(argv)
+    import json as json_mod
+
+    if args.action == "check":
+        # the manifest gate without the rest of jaxlint (CI convenience)
+        from tools.jaxlint.__main__ import main as jaxlint_main
+
+        return jaxlint_main(["--artifacts-only"])
+    if not args.aot_dir:
+        p.error("--aot-dir is required for warm/ls")
+    if args.action == "ls":
+        from harp_tpu.aot.store import ArtifactStore
+
+        for meta in ArtifactStore(args.aot_dir).list():
+            # foreign/partial metas list with placeholders — the listing
+            # tool survives the same seams the store's readers do
+            print(f"{str(meta.get('name') or '?'):32s} "
+                  f"{str(meta.get('format') or '?'):18s} "
+                  f"world={meta.get('world')} "
+                  f"{int(meta.get('payload_bytes') or 0):>8d} B  "
+                  f"{str(meta.get('content_hash') or '')[:12]}")
+        return 0
+    # warm: the export traces run on a virtual CPU mesh at the fleet's
+    # width — never on an accelerator a training gang may hold (the
+    # serving workers themselves run CPU-forced the same way)
+    mesh_workers = args.mesh_workers
+    models = None
+    if args.spec:
+        with open(args.spec) as f:
+            spec = json_mod.load(f)
+        models = spec.get("models") or {}
+        mesh_workers = int(spec.get("mesh_workers", mesh_workers))
+    if args.models_json:
+        models = json_mod.loads(args.models_json)
+    if not models:
+        p.error("warm needs --spec or --models-json")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               f" --xla_force_host_platform_device_count="
+                               f"{mesh_workers}").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if args.compile_cache_dir:
+        from harp_tpu.aot.cache import enable_compile_cache
+
+        enable_compile_cache(args.compile_cache_dir)
+    from harp_tpu.serve import fleet as fleet_mod
+
+    t0 = time.perf_counter()
+    warmed = fleet_mod.warm_artifacts(models, args.aot_dir,
+                                      mesh_workers=mesh_workers,
+                                      version=args.version)
+    dt = time.perf_counter() - t0
+    n = sum(len(b) for b in warmed.values())
+    print(f"aot warm: exported {n} dispatch artifact(s) for "
+          f"{len(warmed)} model(s) at mesh width {mesh_workers} into "
+          f"{args.aot_dir} ({dt:.1f}s): " +
+          ", ".join(f"{m}={b}" for m, b in sorted(warmed.items())))
+    return 0
+
+
 COMMANDS = {
+    "aot": run_aot,
     "kmeans": run_kmeans,
     "sgxsimu": run_sgxsimu,
     "sgd_mf": run_sgd_mf,

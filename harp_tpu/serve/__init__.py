@@ -64,11 +64,27 @@ every reply names the epoch that answered it), a shared
 :class:`~harp_tpu.serve.cache.TopKReplyCache` absorbs Zipfian hot keys at
 the router, and the whole recovery story is scripted through the serving
 fault grammar (``HARP_FAULT=kill|vanish|slow@request=N``).
+
+The AOT artifact layer (r16, ISSUE 15) makes cold starts loads instead of
+compile events: :mod:`harp_tpu.aot` exports every (model, bucket) resident
+dispatch once (``run.py aot warm``), and a worker constructed with
+``ServeWorker(aot_store=)`` / ``local_gang(aot_dir=)`` /
+``ProcessServeGang(aot_dir=)`` installs fresh store hits as its resident
+dispatches and warms them BEFORE rendezvous — ``trace_counts`` stays 0 for
+artifact-loaded buckets (asserted), so an elastic replacement never
+recompiles under traffic. Stale artifacts (jax version, device kind,
+world, layout, or model-hash mismatch) are rejected loudly and fall back
+to compile; the compiled programs themselves are content-hash-pinned in
+``tools/artifact_manifest.json`` (jaxlint ``--artifacts-only``).
+Per-model coalescing deadlines (``max_wait_overrides``, with
+:func:`~harp_tpu.serve.batcher.suggest_max_wait_s` deriving a value from
+the span table's per-model coalesce stage) and jax's persistent
+compilation cache (``compile_cache_dir=``) ride the same surfaces.
 """
 
 from __future__ import annotations
 
-from harp_tpu.serve.batcher import MicroBatcher
+from harp_tpu.serve.batcher import MicroBatcher, suggest_max_wait_s
 from harp_tpu.serve.cache import TopKReplyCache
 from harp_tpu.serve.endpoints import (ClassifyEndpoint, Endpoint,
                                       TopKEndpoint, classify_from_forest,
@@ -89,4 +105,5 @@ __all__ = [
     "classify_from_multiclass_svm", "classify_from_nn", "local_gang",
     "make_placement", "make_placement_get", "make_reply", "make_request",
     "rebalance_from_incidents", "rebalance_from_report",
+    "suggest_max_wait_s",
 ]

@@ -37,6 +37,31 @@ DEFAULT_MAX_WAIT_S = 0.002       # coalescing window: ~the latency floor a
 #                                  waiting for batch-mates
 
 
+def suggest_max_wait_s(metrics, model: str, *, percentile: str = "p90_s",
+                       headroom: float = 1.25, floor: float = 0.0002,
+                       cap: float = 0.05) -> Optional[float]:
+    """Derive a per-model ``max_wait_s`` from the span table's coalesce
+    stage (ISSUE 15 satellite — the PR 14 "per-traffic-class max_wait_s
+    tuning off the span table" REMAINING item).
+
+    The coalesce stage measures how long requests ACTUALLY sat waiting for
+    batch-mates (``serve.span.coalesce.<model>``, recorded per sampled
+    request by :func:`harp_tpu.telemetry.spans.observe_span`). Under
+    traffic dense enough to fill buckets, batches close on size and the
+    observed wait sits far below the configured deadline — the deadline
+    can be tightened to ``headroom ×`` the observed ``percentile`` without
+    losing any batching, cutting the idle tail a sparse period pays. Under
+    sparse traffic the observed wait converges to the deadline itself and
+    the suggestion returns ~the current setting — the helper never spirals
+    a deadline downward on its own observations faster than traffic
+    justifies. Clamped to ``[floor, cap]``; None when the span table has
+    no samples for the model (keep the configured value)."""
+    timing = metrics.timing(f"serve.span.coalesce.{model}")
+    if not timing:
+        return None
+    return float(min(max(timing[percentile] * headroom, floor), cap))
+
+
 class MicroBatcher:
     """Coalesce point queries for ONE endpoint into bucketed dispatches.
 

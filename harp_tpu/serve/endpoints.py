@@ -69,6 +69,13 @@ class Endpoint:
         self.bucket_sizes = sizes
         self._fns: Dict[int, object] = {}        # bucket -> compiled dispatch
         self.trace_counts: Dict[int, int] = {}   # bucket -> actual traces
+        # buckets whose dispatch was INSTALLED from an AOT artifact
+        # (harp_tpu/aot): their program was never traced in this process,
+        # and _count_trace enforces that it never is — a loaded bucket
+        # that traces means the install silently fell through, which must
+        # be a loud failure, not a quiet recompile. Mutated only under
+        # _resident_lock (install_compiled / rebalance).
+        self.aot_loaded: set = set()
         self._state: tuple = ()                  # resident device args
         # (fn, state) must be read as a PAIR: live reshaping operations
         # (TopKEndpoint.rebalance/restore_shard) replace _state and rebuild
@@ -112,6 +119,18 @@ class Endpoint:
     def _count_trace(self, bucket: int) -> None:
         # runs at TRACE time only (Python side effect inside the traced
         # body): the counter ticks exactly when XLA (re)traces this bucket
+        if bucket in self.aot_loaded:
+            # the never-recompile contract (ISSUE 15): an artifact-loaded
+            # bucket replays shipped StableHLO — its Python body must
+            # never run again. Reaching here means the installed fn was
+            # displaced (a bug, or a layout change that forgot to clear
+            # aot_loaded the way rebalance does) — fail the dispatch
+            # loudly instead of silently recompiling under live traffic
+            raise RuntimeError(
+                f"endpoint {self.name!r} bucket {bucket} was loaded from "
+                f"an AOT artifact but is being re-traced — the artifact "
+                f"install was displaced; a loaded bucket must never "
+                f"recompile")
         self.trace_counts[bucket] = self.trace_counts.get(bucket, 0) + 1
 
     def compiled(self, bucket: int):
@@ -122,8 +141,37 @@ class Endpoint:
             self._fns[bucket] = self._build(bucket)
         return self._fns[bucket]
 
+    def install_compiled(self, bucket: int, fn) -> None:
+        """Install an externally prepared dispatch (an AOT artifact load —
+        :mod:`harp_tpu.aot.serve_artifacts`) as this bucket's resident fn.
+        The swap runs under the resident lock like every other (fn, state)
+        mutation; the bucket is marked artifact-loaded, which arms the
+        never-recompile assertion in :meth:`_count_trace`."""
+        if bucket not in self.bucket_sizes:
+            raise ValueError(f"{bucket} is not a configured bucket "
+                             f"{self.bucket_sizes}")
+        with self._resident_lock:
+            self._fns[bucket] = fn
+            self.aot_loaded.add(bucket)
+
     def _build(self, bucket: int):
         raise NotImplementedError
+
+    def _dummy_batch(self) -> np.ndarray:
+        """An EMPTY request batch with the right trailing shape — what the
+        AOT export/warm path feeds :meth:`_place_query` to reproduce a
+        bucket's exact dispatch signature without fabricating traffic (an
+        empty id/feature list leaves the lookup histograms untouched)."""
+        raise NotImplementedError
+
+    def dispatch_args(self, bucket: int) -> tuple:
+        """The full argument tuple of one bucket's dispatch, built from
+        the RESIDENT state and an empty placed query — the abstract
+        signature :mod:`harp_tpu.aot` exports under, and the concrete
+        arguments its warm pass dispatches on."""
+        with self._resident_lock:
+            state = self._state
+        return state + (self._place_query(self._dummy_batch(), bucket),)
 
     def _place_query(self, batch: np.ndarray, bucket: int):
         raise NotImplementedError
@@ -209,6 +257,14 @@ class ClassifyEndpoint(Endpoint):
                          in_specs=(sess.replicate(), sess.shard()),
                          out_specs=sess.shard(),
                          donate_argnums=(1,))
+
+    def _dummy_batch(self) -> np.ndarray:
+        if self.dim is None:
+            raise ValueError(
+                f"classify endpoint {self.name!r} has no declared feature "
+                f"dim — AOT export/warm needs the query signature; "
+                f"construct with dim=")
+        return np.zeros((0, self.dim), np.float32)
 
     def _place_query(self, batch: np.ndarray, bucket: int):
         batch = np.asarray(batch, np.float32)
@@ -623,6 +679,11 @@ class TopKEndpoint(Endpoint):
             self._owner_routed = True
             self._layout_gen += 1
             self._fns.clear()    # owner-routed dispatch is a new program
+            # artifact installs are layout-keyed: the owner-routed layout
+            # is a DIFFERENT program, so the loaded marks clear with the
+            # fns — the lazy rebuild may trace (allowed), and a later
+            # artifact load for the new layout re-marks
+            self.aot_loaded.clear()
         moved = int(plan.moved_rows)
         return {"moved": moved,
                 "owners": {int(r): int(c) for r, c in enumerate(counts)}}
@@ -742,6 +803,9 @@ class TopKEndpoint(Endpoint):
                 "hottest": hottest,
                 "skew": (float(counts[hottest]) * len(counts) / total
                          if total else 0.0)}
+
+    def _dummy_batch(self) -> np.ndarray:
+        return np.zeros((0,), np.int64)
 
     def _place_query(self, batch, bucket: int):
         ids = np.asarray(batch, np.int64)

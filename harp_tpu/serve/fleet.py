@@ -134,6 +134,37 @@ def build_endpoint(session, name: str, mspec: dict, *, version: int = 0,
     raise ValueError(f"unknown model-spec kind {kind!r} for {name!r}")
 
 
+def warm_artifacts(model_specs: Dict[str, dict], aot_dir: str, *,
+                   mesh_workers: int = 2, version: int = 0,
+                   session=None, metrics=None) -> Dict[str, list]:
+    """Offline artifact prebuild (ISSUE 15 — the ``run.py aot warm``
+    body): build every model's endpoint from its deterministic spec at
+    the fleet's mesh width and EXPORT every (model, bucket) resident
+    dispatch into ``aot_dir``. The traces happen here, once; every worker
+    (initial or spare) that starts with this store LOADS instead. Returns
+    ``{model: [buckets exported]}``.
+
+    The caller's process must expose >= ``mesh_workers`` devices (the
+    fleet controller under the tier-1 8-device virtual mesh qualifies for
+    the default width-2 specs); pass ``session`` to reuse one."""
+    from harp_tpu.aot import serve_artifacts
+    from harp_tpu.aot.store import ArtifactStore
+
+    if session is None:
+        from harp_tpu.session import HarpSession
+
+        session = HarpSession(num_workers=int(mesh_workers))
+    store = ArtifactStore(aot_dir, metrics=metrics)
+    out = {}
+    for name, mspec in model_specs.items():
+        ep = build_endpoint(session, name, mspec, version=version)
+        metas = serve_artifacts.export_endpoint(
+            store, ep,
+            model_hash=serve_artifacts.model_hash_from_spec(mspec))
+        out[name] = sorted(metas)
+    return out
+
+
 # --------------------------------------------------------------------------- #
 # Rendezvous directory (the fleet's nodes-file analog)
 # --------------------------------------------------------------------------- #
@@ -141,26 +172,54 @@ def build_endpoint(session, name: str, mspec: dict, *, version: int = 0,
 def read_rendezvous(rdv_dir: str
                     ) -> List[Tuple[int, Tuple[str, int], int]]:
     """Parse every worker address file — ``(rank, (host, port),
-    generation)``, newest generation per rank only. Torn/partial files are
-    skipped (writers use tmp+rename, but a reader must survive any seam)."""
-    best: Dict[int, Tuple[Tuple[str, int], int]] = {}
+    generation)``, newest generation per rank only: the address-map
+    projection of :func:`read_worker_records` (torn/partial files are
+    skipped there — writers use tmp+rename, but a reader must survive any
+    seam)."""
+    out = []
+    for rank, rec in sorted(read_worker_records(rdv_dir).items()):
+        try:
+            out.append((rank, (str(rec["host"]), int(rec["port"])),
+                        int(rec["generation"])))
+        except (KeyError, ValueError, TypeError):
+            continue             # a record without a dialable address
+    return out
+
+
+def read_worker_records(rdv_dir: str) -> Dict[int, dict]:
+    """Full rendezvous record per rank (newest generation) — the stage
+    timings + artifact-load report the bench's restart rows read."""
+    best: Dict[int, dict] = {}
     try:
         names = os.listdir(rdv_dir)
     except OSError:
-        return []
+        return {}
     for fn in names:
-        if not (fn.startswith("w") and fn.endswith(".json")):
+        if not (fn.startswith("w") and fn.endswith(".json")
+                and ".status." not in fn):
             continue
         try:
             with open(os.path.join(rdv_dir, fn)) as f:
                 rec = json.load(f)
             rank, gen = int(rec["rank"]), int(rec["generation"])
-            addr = (str(rec["host"]), int(rec["port"]))
         except (OSError, ValueError, KeyError, TypeError):
             continue
-        if rank not in best or best[rank][1] < gen:
-            best[rank] = (addr, gen)
-    return [(r, addr, gen) for r, (addr, gen) in sorted(best.items())]
+        if rank not in best or int(best[rank]["generation"]) < gen:
+            best[rank] = rec
+    return best
+
+
+def read_status(rdv_dir: str, rank: int,
+                generation: int) -> Optional[dict]:
+    """One worker's post-exit status record (trace_counts, aot_loaded,
+    requests served) — written by a cleanly stopped subprocess worker;
+    None while the worker lives or after an abrupt death."""
+    path = os.path.join(rdv_dir, f"w{rank}.g{generation}.status.json")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
 
 
 def classify_exit(rc: int) -> str:
@@ -210,13 +269,17 @@ class ProcessServeGang:
     def __init__(self, model_specs: Dict[str, dict],
                  placement: Dict[str, int], *,
                  workdir: Optional[str] = None, mesh_workers: int = 2,
-                 max_wait_s: float = 0.002, cache: bool = False,
+                 max_wait_s: float = 0.002,
+                 max_wait_overrides: Optional[Dict[str, float]] = None,
+                 cache: bool = False,
                  slo_p99_s: Optional[float] = None,
                  slo_kw: Optional[dict] = None,
                  telemetry_dir: Optional[str] = None,
                  env_extra: Optional[dict] = None,
                  spare_hosts: Optional[List[str]] = None,
                  recover_on_death: bool = True,
+                 aot_dir: Optional[str] = None,
+                 compile_cache_dir: Optional[str] = None,
                  python: Optional[str] = None, metrics=None):
         if metrics is None:
             from harp_tpu.utils.metrics import DEFAULT as metrics
@@ -257,9 +320,18 @@ class ProcessServeGang:
                 "secret": self.secret.hex(),
                 "mesh_workers": int(mesh_workers),
                 "max_wait_s": float(max_wait_s), "cache": bool(cache),
+                "max_wait_overrides": {str(m): float(v) for m, v in
+                                       (max_wait_overrides or {}).items()},
                 "slo_p99_s": slo_p99_s, "slo_kw": slo_kw or {},
                 "telemetry_dir": telemetry_dir,
+                # AOT cold start (ISSUE 15): every member — initial and
+                # SPARE — prepares its dispatches from this store before
+                # rendezvous, so an elastic replacement never recompiles;
+                # the compile cache composes underneath
+                "aot_dir": aot_dir,
+                "compile_cache_dir": compile_cache_dir,
             }, f, indent=1)
+        self.aot_dir = aot_dir
         # mutable fleet state, guarded by _lock: the monitor thread and
         # the caller's thread both touch it
         self._lock = threading.Lock()

@@ -93,9 +93,14 @@ class ServeWorker:
                  placement: Dict[str, int], *,
                  peers: Optional[Dict[int, Tuple[str, int]]] = None,
                  secret: Optional[bytes] = None, host: str = "127.0.0.1",
-                 max_wait_s: float = DEFAULT_MAX_WAIT_S, metrics=None,
+                 max_wait_s: float = DEFAULT_MAX_WAIT_S,
+                 max_wait_overrides: Optional[Dict[str, float]] = None,
+                 metrics=None,
                  slo=None, metrics_port: Optional[int] = None,
                  cache=None, fault_exit: bool = False,
+                 aot_store=None,
+                 aot_model_hashes: Optional[Dict[str, str]] = None,
+                 compile_cache_dir: Optional[str] = None,
                  on_control: Optional[Callable[[dict], None]] = None):
         if metrics is None:
             from harp_tpu.utils.metrics import DEFAULT as metrics
@@ -103,6 +108,35 @@ class ServeWorker:
         self.rank = rank
         self.placement = dict(placement)
         self.endpoints = dict(endpoints)
+        # AOT cold start (ISSUE 15): the persistent compilation cache is
+        # wired first (whatever still compiles below loads from it), then
+        # every endpoint PREPARES FROM ARTIFACTS — fresh store hits are
+        # installed as the resident dispatch (trace_counts stays 0 for
+        # those buckets, asserted by the endpoint) and warmed; misses are
+        # compiled AND warmed now, so an aot-enabled worker never serves
+        # a cold bucket either way. All of this happens before the
+        # receive thread starts — for a fleet subprocess that means
+        # before rendezvous: an elastic replacement never recompiles
+        # under traffic.
+        if compile_cache_dir:
+            from harp_tpu.aot.cache import enable_compile_cache
+
+            enable_compile_cache(compile_cache_dir)
+        self.aot_loaded: Dict[str, list] = {}
+        if aot_store is not None:
+            from harp_tpu.aot import serve_artifacts
+            from harp_tpu.aot.store import ArtifactStore
+
+            if isinstance(aot_store, str):
+                aot_store = ArtifactStore(aot_store, metrics=metrics)
+            hashes = aot_model_hashes or {}
+            for name, ep in self.endpoints.items():
+                loaded = serve_artifacts.load_endpoint(
+                    aot_store, ep, model_hash=hashes.get(name),
+                    warm=True, warm_missing=True)
+                self.aot_loaded[name] = loaded
+                metrics.count(f"serve.aot_loaded_buckets.{name}",
+                              len(loaded))
         # gang ranks are reserved: a reply_to rank colliding with a serving
         # worker must never overwrite the forwarding route to that worker.
         # placement/_worker_ranks/placement_version mutate together under
@@ -137,9 +171,17 @@ class ServeWorker:
                                       peers=peers if peers is not None
                                       else {},
                                       secret=secret, host=host)
+        # per-model coalescing deadlines (ISSUE 15 satellite): a model's
+        # override beats the worker-wide default — two models on one
+        # worker can run different latency/batching trades (the
+        # suggest_max_wait_s helper derives a value from the span table)
+        overrides = max_wait_overrides or {}
+        self.max_wait_overrides = {str(m): float(v)
+                                   for m, v in overrides.items()}
         self.batchers: Dict[str, MicroBatcher] = {
             name: MicroBatcher(ep, self._make_reply_fn(), metrics=metrics,
-                               max_wait_s=max_wait_s)
+                               max_wait_s=self.max_wait_overrides.get(
+                                   name, max_wait_s))
             for name, ep in self.endpoints.items()}
         # drain flag crosses threads (begin_drain on the caller's thread,
         # checked in the receive loop): an Event, not a bare bool — the
@@ -887,12 +929,15 @@ class RouterClient:
 
 def local_gang(session, worker_endpoints: List[Dict[str, object]], *,
                secret: Optional[bytes] = b"harp-serve-local",
-               max_wait_s: float = DEFAULT_MAX_WAIT_S, metrics=None,
+               max_wait_s: float = DEFAULT_MAX_WAIT_S,
+               max_wait_overrides: Optional[Dict[str, float]] = None,
+               metrics=None,
                slo_p99_s: Optional[float] = None,
                slo_kw: Optional[dict] = None,
                metrics_port: Optional[int] = None,
                trace_sample: Optional[int] = None,
-               cache=None
+               cache=None, aot_dir: Optional[str] = None,
+               compile_cache_dir: Optional[str] = None
                ) -> Tuple[List[ServeWorker], Callable[..., RouterClient]]:
     """An in-process serving gang on loopback (the tier-1/bench topology;
     multi-host gangs pass explicit peer maps or KV rendezvous instead).
@@ -919,6 +964,9 @@ def local_gang(session, worker_endpoints: List[Dict[str, object]], *,
                  for name in eps}
     workers = [ServeWorker(session, r, eps, placement, peers={},
                            secret=secret, max_wait_s=max_wait_s,
+                           max_wait_overrides=max_wait_overrides,
+                           aot_store=aot_dir,
+                           compile_cache_dir=compile_cache_dir,
                            metrics=metrics, cache=cache,
                            slo=(SLOWatchdog(slo_p99_s, rank=r,
                                             metrics=metrics,

@@ -24,12 +24,24 @@ The process:
    classification code the fleet supervisor maps to CRASH/VANISH — and an
    ``on_control`` hook that serves live-refresh pushes
    (``{"op": "refresh", "version": V}`` regenerates epoch V's factors and
-   ``push_epoch``\\ s them on a side thread while traffic keeps flowing);
+   ``push_epoch``\\ s them on a side thread while traffic keeps flowing).
+   With an ``aot_dir`` (spec field or ``--aot-dir``) the ctor PREPARES
+   FROM ARTIFACTS: store hits are installed as the resident dispatches
+   (``trace_counts`` stays 0 for them — the never-recompile contract) and
+   every bucket is warmed, all BEFORE rendezvous — an elastic replacement
+   never compiles under traffic (ISSUE 15). ``compile_cache_dir`` wires
+   jax's persistent compilation cache underneath either path;
 4. publishes its address atomically into the rendezvous directory
-   (``w<rank>.g<generation>.json``) and keeps re-reading the directory so
-   late or replaced peers get dialed;
+   (``w<rank>.g<generation>.json``) together with its measured START-UP
+   STAGE timings (jax init / build+restore / compile-or-load) — the
+   recovery-window breakdown the bench and PERF.md quote is measured
+   here, not guessed — and keeps re-reading the directory so late or
+   replaced peers get dialed;
 5. serves until the controller drops the ``stop`` file, then drains
-   cleanly and exits 0.
+   cleanly, writes a final ``w<rank>.g<generation>.status.json`` (per-
+   model ``trace_counts``, artifact-loaded buckets, requests served — the
+   zero-recompile assertions read THIS, from outside the corpse), and
+   exits 0.
 """
 
 from __future__ import annotations
@@ -53,6 +65,9 @@ def _force_cpu(mesh_workers: int) -> None:
 
 
 def main(argv=None) -> int:
+    t0 = time.perf_counter()
+    t0_wall = time.time()        # lets the controller price spawn→main
+    #                              (interpreter + harp_tpu import) too
     p = argparse.ArgumentParser(prog="harp_tpu.serve.worker")
     p.add_argument("--spec", required=True, help="fleet spec JSON path")
     p.add_argument("--rank", type=int, required=True)
@@ -63,27 +78,44 @@ def main(argv=None) -> int:
     p.add_argument("--restore", action="store_true",
                    help="spare path: zero-build the top-k stores, then "
                         "restore them through the on-device reshard engine")
+    p.add_argument("--aot-dir", default=None,
+                   help="artifact store to prepare dispatches from "
+                        "(overrides the spec's aot_dir; '' disables)")
+    p.add_argument("--compile-cache-dir", default=None,
+                   help="jax persistent compilation cache (overrides the "
+                        "spec's compile_cache_dir)")
     args = p.parse_args(argv)
     with open(args.spec) as f:
         spec = json.load(f)
     _force_cpu(int(spec.get("mesh_workers", 2)))
+    stages = {"jax_init_s": round(time.perf_counter() - t0, 4)}
 
+    from harp_tpu.aot import serve_artifacts
     from harp_tpu.serve import fleet as fleet_mod
     from harp_tpu.serve.cache import TopKReplyCache
     from harp_tpu.serve.endpoints import TopKEndpoint
     from harp_tpu.serve.router import ServeWorker
     from harp_tpu.session import HarpSession
 
+    aot_dir = (args.aot_dir if args.aot_dir is not None
+               else spec.get("aot_dir")) or None
+    compile_cache_dir = (args.compile_cache_dir
+                         if args.compile_cache_dir is not None
+                         else spec.get("compile_cache_dir")) or None
     rank = args.rank
+    t1 = time.perf_counter()
     session = HarpSession(num_workers=int(spec.get("mesh_workers", 2)))
     placement = {str(m): int(r) for m, r in spec["placement"].items()}
     endpoints = {}
+    model_hashes = {}
     for name, mspec in spec["models"].items():
         if placement.get(name) != rank:
             continue
         endpoints[name] = fleet_mod.build_endpoint(
             session, name, mspec, version=args.version,
             restore=args.restore)
+        model_hashes[name] = serve_artifacts.model_hash_from_spec(mspec)
+    stages["build_restore_s"] = round(time.perf_counter() - t1, 4)
 
     slo = None
     if spec.get("slo_p99_s"):
@@ -123,11 +155,23 @@ def main(argv=None) -> int:
         threading.Thread(target=_apply, daemon=True,
                          name=f"harp-serve-refresh-{rank}").start()
 
+    overrides = {str(m): float(v) for m, v in
+                 (spec.get("max_wait_overrides") or {}).items()}
+    t2 = time.perf_counter()
     worker = ServeWorker(
         session, rank, endpoints, placement,
         peers={}, secret=bytes.fromhex(spec["secret"]),
         max_wait_s=float(spec.get("max_wait_s", 0.002)),
+        max_wait_overrides=overrides,
+        aot_store=aot_dir, aot_model_hashes=model_hashes,
+        compile_cache_dir=compile_cache_dir,
         slo=slo, cache=cache, fault_exit=True, on_control=on_control)
+    # with aot on, the ctor loaded/compiled AND warmed every bucket —
+    # this stage is the whole artifacts-vs-compile comparison; without
+    # aot it is ~0 and the first post-rendezvous dispatch pays instead
+    stages["compile_or_load_s"] = round(time.perf_counter() - t2, 4)
+    stages["total_to_ready_s"] = round(time.perf_counter() - t0, 4)
+    stages["main_unix_ts"] = round(t0_wall, 4)
 
     rdv_dir = spec["rendezvous_dir"]
     my_file = os.path.join(rdv_dir, f"w{rank}.g{args.generation}.json")
@@ -135,7 +179,11 @@ def main(argv=None) -> int:
     with open(tmp, "w") as f:
         json.dump({"rank": rank, "generation": args.generation,
                    "host": worker.address[0], "port": worker.address[1],
-                   "pid": os.getpid(), "version": args.version}, f)
+                   "pid": os.getpid(), "version": args.version,
+                   "restore": bool(args.restore),
+                   "aot": bool(aot_dir), "stages": stages,
+                   "aot_loaded": {m: list(b) for m, b
+                                  in worker.aot_loaded.items()}}, f)
     os.replace(tmp, my_file)
 
     stop_file = os.path.join(rdv_dir, "stop")
@@ -152,6 +200,26 @@ def main(argv=None) -> int:
             time.sleep(0.1)
     finally:
         worker.close()
+        # the post-mortem surface: trace_counts per model (the zero-
+        # recompile assertion reads this from OUTSIDE the process) plus
+        # how much traffic the worker actually carried
+        status = {
+            "rank": rank, "generation": args.generation,
+            "aot": bool(aot_dir),
+            "aot_loaded": {m: list(b) for m, b
+                           in worker.aot_loaded.items()},
+            "trace_counts": {m: {str(b): int(n) for b, n
+                                 in ep.trace_counts.items()}
+                             for m, ep in endpoints.items()},
+            "requests": int(worker.metrics.snapshot()["counters"].get(
+                "serve.requests", 0)),
+        }
+        status_file = os.path.join(
+            rdv_dir, f"w{rank}.g{args.generation}.status.json")
+        tmp = status_file + f".tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(status, f)
+        os.replace(tmp, status_file)
     return 0
 
 

@@ -187,6 +187,13 @@ def observe_span(bd: Dict, metrics) -> None:
     metrics.observe("serve.span.total", bd["total_s"])
     for stage in STAGES:
         metrics.observe(f"serve.span.{stage}", bd[f"{stage}_s"])
+    if bd.get("model"):
+        # the one per-model stage split (ISSUE 15 satellite): coalesce is
+        # the stage a per-model max_wait_s deadline governs, so the
+        # suggest_max_wait_s helper needs it PER MODEL — one extra
+        # reservoir per served model, nothing else fans out
+        metrics.observe(f"serve.span.coalesce.{bd['model']}",
+                        bd["coalesce_s"])
     metrics.count("serve.spans")
     if bd["forwarded"]:
         metrics.count("serve.spans_forwarded")

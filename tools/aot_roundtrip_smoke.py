@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""AOT artifact round-trip smoke (ISSUE 15 satellite — ci_checks stage 7).
+
+One bounded, self-contained pass over the whole artifact story:
+
+  1. EXPORT   — the manifest registry's serving models export every
+               (model, bucket) resident dispatch into a temp store;
+  2. HASH     — the freshly exported content hashes must match the
+               committed ``tools/artifact_manifest.json`` (the jaxlint
+               gate, re-asserted here so this stage is self-sufficient);
+  3. LOAD     — FRESH endpoints (same deterministic specs) install every
+               artifact; all buckets must hit, none may trace
+               (``trace_counts`` stays empty — the never-recompile
+               contract);
+  4. PARITY   — for real query batches, the loaded dispatch must answer
+               bit-identically to the freshly compiled donor dispatch.
+
+Exit nonzero on any failure. Usage: ``python -m tools.aot_roundtrip_smoke``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+
+def main() -> int:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from tools.jaxlint.trace_targets import ensure_cpu_mesh
+
+    ensure_cpu_mesh()
+    import numpy as np
+
+    from harp_tpu.aot import manifest, serve_artifacts
+    from harp_tpu.aot.store import ArtifactStore
+    from harp_tpu.serve import fleet as fleet_mod
+    from harp_tpu.utils.metrics import Metrics
+
+    failures = []
+    metrics = Metrics()
+    workdir = tempfile.mkdtemp(prefix="harp-aot-smoke-")
+    store = ArtifactStore(workdir, metrics=metrics)
+
+    # 1-2. export + hash-check against the committed manifest
+    findings = manifest.check(root, workdir)
+    for f in findings:
+        failures.append(f"hash-check: {f}")
+    print(f"aot smoke: manifest hash-check — {len(findings)} finding(s)")
+
+    # 3-4. load into fresh endpoints, zero-trace + serve parity
+    sess = manifest._session()
+    rng = np.random.default_rng(20)
+    for model, mspec in manifest.SERVE_MODELS.items():
+        # the donor compiles fresh (the parity reference); the twin loads
+        # the artifacts manifest.check already exported into this same
+        # workdir — no second export of identical programs
+        donor = fleet_mod.build_endpoint(sess, model, mspec)
+        twin = fleet_mod.build_endpoint(sess, model, mspec)
+        loaded = serve_artifacts.load_endpoint(
+            store, twin,
+            model_hash=serve_artifacts.model_hash_from_spec(mspec))
+        if loaded != sorted(donor.bucket_sizes):
+            failures.append(f"{model}: loaded {loaded}, wanted every "
+                            f"bucket {sorted(donor.bucket_sizes)}")
+            continue
+        for n in (1, donor.bucket_sizes[0]):
+            if mspec["kind"] == "topk":
+                batch = rng.integers(0, int(mspec["num_users"]), size=n)
+            else:
+                batch = rng.normal(size=(n, int(mspec["dim"]))).astype(
+                    np.float32)
+            got, want = twin.dispatch(batch), donor.dispatch(batch)
+            if got != want:
+                failures.append(f"{model} n={n}: loaded dispatch diverged "
+                                f"from compiled: {got[:1]} vs {want[:1]}")
+        if twin.trace_counts:
+            failures.append(f"{model}: artifact-loaded endpoint TRACED "
+                            f"{twin.trace_counts} — the load silently "
+                            f"fell back to compile")
+        print(f"aot smoke: {model} — {len(loaded)} bucket(s) loaded, "
+              f"parity checked, trace_counts={twin.trace_counts}")
+
+    counters = metrics.snapshot()["counters"]
+    misses = {k: v for k, v in counters.items()
+              if k.startswith("aot.store.miss_")}
+    if misses:
+        failures.append(f"unexpected store misses in a same-process "
+                        f"round trip: {misses}")
+    if failures:
+        for f in failures:
+            print(f"aot smoke FAIL: {f}", file=sys.stderr)
+        return 1
+    print(f"aot smoke: round trip clean "
+          f"(hits={int(counters.get('aot.store.hit', 0))})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

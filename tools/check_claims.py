@@ -251,6 +251,48 @@ CLAIMS: List[Claim] = [
     Claim("fleet_hotkey_hot_p99_speedup", "PERF.md",
           r"Hot-subset p99 improves (\S+)x",
           ("serving_fleet", "hotkey", "hot_p99_speedup")),
+    # PERF.md r16 + README "Instant cold start" (ISSUE 15): the
+    # restart-to-first-reply comparison (artifacts off / on / on+compile
+    # cache), the serving-window collapse, the artifacts-on recovery
+    # window, and the pinned-artifact count against the manifest itself.
+    # Cold-start totals are subprocess timings (moderate bands); the
+    # serving-window and recovery numbers inherit the r15 recovery bands.
+    Claim("restart_no_aot_total", "PERF.md",
+          r"\| no artifacts \| (\S+) s",
+          ("serving_fleet", "restart", "no_aot",
+           "restart_to_first_reply_s"), rel_tol=0.25),
+    Claim("restart_no_aot_window", "PERF.md",
+          r"\| no artifacts \| \S+ s \| (\S+) s",
+          ("serving_fleet", "restart", "no_aot",
+           "rendezvous_to_first_reply_s"), rel_tol=0.5),
+    Claim("restart_aot_total", "PERF.md",
+          r"\| artifacts \| (\S+) s",
+          ("serving_fleet", "restart", "aot",
+           "restart_to_first_reply_s"), rel_tol=0.25),
+    Claim("restart_aot_window", "PERF.md",
+          r"\| artifacts \| \S+ s \| (\S+) s",
+          ("serving_fleet", "restart", "aot",
+           "rendezvous_to_first_reply_s"), rel_tol=0.5),
+    Claim("restart_aot_cache_total", "PERF.md",
+          r"\| artifacts \+ compile cache \| (\S+) s",
+          ("serving_fleet", "restart", "aot_cache",
+           "restart_to_first_reply_s"), rel_tol=0.25),
+    Claim("restart_window_speedup", "PERF.md",
+          r"rendezvous→first reply drops \S+ s → \S+ s \((\S+)x\)",
+          ("serving_fleet", "restart", "serving_window_speedup"),
+          rel_tol=0.5),
+    Claim("restart_window_speedup_readme", "README.md",
+          r"drops \S+ s → \S+ s \((\S+)×\)",
+          ("serving_fleet", "restart", "serving_window_speedup"),
+          rel_tol=0.5),
+    Claim("recovery_aot_observed_s", "PERF.md",
+          r"observed window (\S+) s",
+          ("serving_fleet", "recovery_aot", "observed_recovery_s"),
+          rel_tol=0.5),
+    Claim("artifact_manifest_count", "README.md",
+          r"content-hashes the (\S+) registry programs",
+          lambda m: float(len(m["artifacts"])), rel_tol=0.0,
+          file="tools/artifact_manifest.json"),
     Claim("comm_serve_classify", "PERF.md",
           r"Serve classify dispatch \(serve_classify_nn\) \| (\S+) B",
           ("targets", "serve_classify_nn", "bytes_per_step"),

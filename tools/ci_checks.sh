@@ -73,6 +73,18 @@
 #   5. tier-1       — the ROADMAP.md verify suite (which itself re-runs
 #                     jaxlint's clean-repo + budget checks as tests, so
 #                     DOTS_PASSED captures them).
+#   7. aot round-trip — ISSUE 15: the compiled-program artifact story
+#                     end to end (tools/aot_roundtrip_smoke.py): export
+#                     the registry's serving dispatches → content hashes
+#                     must match the pinned tools/artifact_manifest.json
+#                     (also checked inside stage 1's full jaxlint run:
+#                     a silently changed compiled program is a finding,
+#                     `python -m tools.jaxlint --update-artifacts`
+#                     regenerates deliberately) → load into FRESH
+#                     endpoints (every bucket hits, trace_counts stays 0
+#                     — the never-recompile contract) → loaded dispatch
+#                     answers bit-identically to the freshly compiled
+#                     one.
 #   6. serving chaos — ISSUE 14: a scripted kill-under-load on the
 #                     in-process serving gang (HARP_FAULT=kill@request=N
 #                     through the serving fault grammar): the LocalFleet
@@ -92,15 +104,15 @@ set -u
 cd "$(dirname "$0")/.."
 rc=0
 
-echo "== [1/6] jaxlint (AST + JL3xx concurrency + jaxpr + gang budgets) =="
+echo "== [1/7] jaxlint (AST + JL3xx concurrency + jaxpr + gang budgets + artifact manifest) =="
 python -m tools.jaxlint || rc=1
 
-echo "== [2/6] jaxlint budget with telemetry + request tracing ON (zero drift) =="
+echo "== [2/7] jaxlint budget with telemetry + request tracing ON (zero drift) =="
 tele_dir="$(mktemp -d /tmp/_tele_gate.XXXXXX)"
 HARP_TELEMETRY_DIR="$tele_dir" HARP_TRACE_REQUESTS=1 \
     python -m tools.jaxlint --jaxpr-only || rc=1
 
-echo "== [3/6] gang-mode collective budgets (virtual multi-process mesh) =="
+echo "== [3/7] gang-mode collective budgets (virtual multi-process mesh) =="
 # ISSUE 13: the dryrun_multichip gang-mode step programs traced on the
 # virtual 2-host x 4-device mesh with the workers axis hinted DCN —
 # counts, per-process shard shapes, and the DCN/ICI link-class byte split
@@ -111,10 +123,10 @@ echo "== [3/6] gang-mode collective budgets (virtual multi-process mesh) =="
 # its own stage banner in CI output instead of buried in stage 1's.
 python -m tools.jaxlint --gang-only || rc=1
 
-echo "== [4/6] check_claims =="
+echo "== [4/7] check_claims =="
 python tools/check_claims.py || rc=1
 
-echo "== [5/6] tier-1 tests =="
+echo "== [5/7] tier-1 tests =="
 set -o pipefail
 t1_log="$(mktemp /tmp/_t1.XXXXXX.log)"   # unique per run: concurrent CI
 trap 'rm -f "$t1_log"; rm -rf "$tele_dir"' EXIT   # must not clobber the count
@@ -124,10 +136,13 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$t1_log" \
     | tr -cd . | wc -c)"
 
-echo "== [6/6] serving-chaos smoke (scripted kill under load, zero failures) =="
+echo "== [6/7] serving-chaos smoke (scripted kill under load, zero failures) =="
 # bounded like stage 5: a wedged recovery (the exact machinery this smoke
 # exercises) must fail CI, never hang it
 timeout -k 10 300 python -m tools.serving_chaos_smoke || rc=1
+
+echo "== [7/7] aot artifact round-trip (export -> hash-check -> load -> parity) =="
+timeout -k 10 300 python -m tools.aot_roundtrip_smoke || rc=1
 
 if [ "$rc" -ne 0 ]; then
     echo "ci_checks: FAILED"

@@ -43,6 +43,17 @@ def main(argv=None) -> int:
     parser.add_argument("--update-budget", action="store_true",
                         help="retrace all targets (both engines) and "
                              "rewrite tools/collective_budget.json")
+    parser.add_argument("--artifacts-only", action="store_true",
+                        help="check ONLY the pinned compiled-program "
+                             "manifest (tools/artifact_manifest.json): "
+                             "re-export the harp_tpu.aot registry and "
+                             "diff content hashes — a silently changed "
+                             "compiled program is a finding (ISSUE 15)")
+    parser.add_argument("--update-artifacts", action="store_true",
+                        help="re-export the AOT artifact registry and "
+                             "rewrite tools/artifact_manifest.json "
+                             "(commit the diff deliberately — it is the "
+                             "compiled-program contract)")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="one finding per line as JSON (file, line, "
                              "code, message, allowlisted flag)")
@@ -60,6 +71,13 @@ def main(argv=None) -> int:
     if args.gang_only and args.update_budget:
         parser.error("--update-budget retraces BOTH registries so the "
                      "manifest stays whole; drop --gang-only")
+    if args.artifacts_only and (args.ast_only or args.jaxpr_only
+                                or args.gang_only):
+        parser.error("--artifacts-only excludes the other engine "
+                     "selectors (it runs exactly one engine already)")
+    if args.artifacts_only and args.update_budget:
+        parser.error("--update-budget needs the jaxpr engines; drop "
+                     "--artifacts-only (or use --update-artifacts)")
 
     root = args.root or os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
@@ -102,7 +120,7 @@ def main(argv=None) -> int:
         out_note(f"allowlist schema: {e}", code="allowlist-schema")
     problems += len(schema_errors)
 
-    if not (args.jaxpr_only or args.gang_only):
+    if not (args.jaxpr_only or args.gang_only or args.artifacts_only):
         raw = run_ast_checkers(root, ast_checkers_for_repo(root))
         active, stale = apply_allowlist(raw, ALLOWLIST)
         active_keys = {id(f) for f in active}
@@ -114,7 +132,7 @@ def main(argv=None) -> int:
         status(f"ast engine: {len(active)} finding(s), {len(stale)} stale "
                f"allowlist entr(ies)")
 
-    if not args.ast_only:
+    if not (args.ast_only or args.artifacts_only):
         from tools.jaxlint import checkers_jaxpr
 
         traced = None
@@ -138,6 +156,36 @@ def main(argv=None) -> int:
         problems += len(gang_findings)
         status(f"gang engine: {len(gang)} gang-mode targets traced, "
                f"{len(gang_findings)} finding(s)")
+
+    # the compiled-program manifest (ISSUE 15): re-export the AOT registry
+    # and hash-diff against tools/artifact_manifest.json — runs in the
+    # full default pass and under --artifacts-only (the telemetry and
+    # gang stages re-trace enough already; a program drift shows up here
+    # regardless of which stage's pass caught it first)
+    if args.artifacts_only or args.update_artifacts or not (
+            args.ast_only or args.jaxpr_only or args.gang_only):
+        import shutil
+        import tempfile
+
+        from tools.jaxlint.trace_targets import ensure_cpu_mesh
+
+        ensure_cpu_mesh()
+        from harp_tpu.aot import manifest as aot_manifest
+
+        workdir = tempfile.mkdtemp(prefix="harp-aot-lint-")
+        try:
+            if args.update_artifacts:
+                path = aot_manifest.update(root, workdir)
+                status(f"wrote {os.path.relpath(path, root)}")
+            else:
+                art_findings = aot_manifest.check(root, workdir)
+                for msg in art_findings:
+                    out_note(msg, code="artifact-drift")
+                problems += len(art_findings)
+                status(f"artifact engine: manifest checked, "
+                       f"{len(art_findings)} finding(s)")
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
 
     if problems:
         status(f"jaxlint: {problems} problem(s)")

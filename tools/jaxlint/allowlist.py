@@ -94,6 +94,11 @@ ALLOWLIST: Allowlist = {
         "calls out why collective ops must stay boundary-aligned)",
 
     # -- JL105 broad-except: blast radius deliberately wide ----------------
+    ("harp_tpu/aot/store.py", "load", "JL105"):
+        "deserializing a stale/foreign artifact payload can raise "
+        "anything the jax.export/StableHLO loader reaches; the contract "
+        "is degrade-to-compile with a metered miss, never crash a "
+        "starting worker over a bad cache file",
     ("harp_tpu/parallel/p2p.py", "_reader", "JL105"):
         "an undecodable peer payload (gang version skew) can raise "
         "anything pickle-reachable; the frame boundary is intact, so the "
