@@ -1027,7 +1027,30 @@ def tpu_serving_fleet(small=False):
             zipf_alpha=1.2),
         "restart": serving_fleet.measure_restart(
             repeats=2 if small else 3),
+        # ISSUE 16: QPS ramp with the demand-driven autoscaler closing the
+        # loop — worker count must follow the ramp up AND back down, the
+        # scale-up journaled with its placement version, zero trace
+        # counts, and AOT-store loads (the elastic worker never compiles).
+        # Subprocess on the 8-device virtual mesh (reshard_bench idiom):
+        # the restore-built movers and the AOT store's traced layouts only
+        # agree at the fleet's real mesh width, not on this process's
+        # possibly-single device
+        "autoscale": _autoscale_subprocess(small),
     }
+
+
+def _autoscale_subprocess(small=False):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "") +
+                         " --xla_force_host_platform_device_count=8"
+                         ).strip()}
+    out = subprocess.run(
+        [sys.executable, "-m", "harp_tpu.benchmark.serving_fleet",
+         f"--ramp_hold_s={5.0 if small else 8.0}"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    if out.returncode != 0:
+        return {"error": out.stderr[-500:]}
+    return json.loads(out.stdout.strip().splitlines()[-1])
 
 
 def tpu_reshard(small=False):
@@ -1607,6 +1630,18 @@ def main():
                      or {}).get("hit_rate"),
                 "fleet_hotkey_hot_p99_speedup":
                     hot_row.get("hot_p99_speedup")})
+            asc_row = frow.get("autoscale", {})
+            asc_up = asc_row.get("scale_up") or {}
+            compact.update({
+                "fleet_autoscale_errors": asc_row.get("errors"),
+                "fleet_autoscale_wrong": asc_row.get("wrong_results"),
+                "fleet_autoscale_peak_workers":
+                    asc_row.get("peak_workers"),
+                "fleet_autoscale_final_workers":
+                    asc_row.get("final_workers"),
+                "fleet_autoscale_up_trace_count":
+                    (sum(asc_up["trace_counts"].values())
+                     if asc_up.get("trace_counts") else None)})
 
     if want("reshard"):
         begin("reshard")

@@ -105,6 +105,13 @@ def load_endpoint(store: ArtifactStore, ep, *,
     traffic)."""
     import jax
 
+    # collective warm dispatches (top-k) must not overlap other collective
+    # programs on the shared mesh — same gate the live dispatch path holds
+    from contextlib import nullcontext
+
+    from harp_tpu.serve.endpoints import _COLLECTIVE_GATE
+    gate = (_COLLECTIVE_GATE if getattr(ep, "collective_dispatch", False)
+            else nullcontext())
     loaded = []
     try:
         args0 = ep.dispatch_args(ep.bucket_sizes[0])
@@ -127,8 +134,9 @@ def load_endpoint(store: ArtifactStore, ep, *,
         hit = store.load(_key(ep, bucket, args, model_hash))
         if hit is None:
             if warm_missing:
-                jax.block_until_ready(ep.compiled(bucket)(
-                    *ep.dispatch_args(bucket)))
+                with gate:
+                    jax.block_until_ready(ep.compiled(bucket)(
+                        *ep.dispatch_args(bucket)))
             continue
         fn, _meta = hit
         ep.install_compiled(bucket, fn)
@@ -138,5 +146,6 @@ def load_endpoint(store: ArtifactStore, ep, *,
             # (or compile-cache load) happens here, pre-rendezvous; the
             # dummy args are rebuilt because the loaded jit holds no
             # donation contract but the compile-path twin above does
-            jax.block_until_ready(fn(*ep.dispatch_args(bucket)))
+            with gate:
+                jax.block_until_ready(fn(*ep.dispatch_args(bucket)))
     return loaded

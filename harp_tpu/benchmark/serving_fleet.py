@@ -25,6 +25,13 @@ committed next to ``--only serving``'s latency rows:
   (:class:`~harp_tpu.serve.cache.TopKReplyCache`): per-pass p50/p99/QPS,
   the endpoint's ``lookup_skew`` histogram (the PR 12 measurement the
   hot-key work is built against), and the cache hit rate.
+* :func:`measure_autoscale` — ISSUE 16: a QPS ramp against a one-worker
+  in-process fleet with the demand-driven autoscaler closing the loop:
+  the row carries the worker-count trajectory (UP under pressure, back
+  DOWN when the ramp subsides), every decision with the signals that
+  drove it, the scale-up's journaled placement version + zero trace
+  counts + AOT-store loads, and the served/shed/wrong tallies (zero
+  failed, zero wrong asserted by tier-1's twin and the stage-8 smoke).
 
 All rows carry ``device`` — CPU-mesh numbers price the router/recovery
 machinery with CPU dispatches; the driver's on-chip run re-measures.
@@ -672,15 +679,240 @@ def measure_hotkey(session=None, *, num_users: int = 512,
     return row
 
 
+# --------------------------------------------------------------------------- #
+# Autoscale ramp (in-process fleet, demand-driven controller)
+# --------------------------------------------------------------------------- #
+
+def measure_autoscale(session=None, *, n_models: int = 3,
+                      num_users: int = 32, num_items: int = 16,
+                      rank: int = 4, k: int = 3, num_clients: int = 10,
+                      max_queue: int = 48, ramp_hold_s: float = 8.0,
+                      ramp_timeout_s: float = 30.0, max_workers: int = 3,
+                      seed: int = 17,
+                      prebuild_artifacts: bool = True) -> dict:
+    """QPS ramp against a one-worker in-process gang with the
+    demand-driven :class:`~harp_tpu.serve.autoscaler.Autoscaler` closing
+    the loop (ISSUE 16 acceptance): the worker count must follow the ramp
+    UP (queue-depth/shed pressure → ``scale_up`` through the versioned
+    placement push, the fresh worker warming from the AOT store with
+    ``trace_counts`` 0) and back DOWN once the clients stop (LIFO retire
+    through the same builder path). Every answered reply is checked
+    against the canonical top-k reference; a retry-exhausted ``overloaded``
+    reply is a CLEAN shed (that is the admission-control contract), any
+    other failure fails the row. The scenario runs on its own
+    :class:`~harp_tpu.utils.metrics.Metrics` registry so the controller's
+    shed/served deltas cannot be polluted by earlier bench rows."""
+    import tempfile
+
+    from harp_tpu.serve import OP_TOPK, local_gang, protocol
+    from harp_tpu.serve import fleet as fleet_mod
+    from harp_tpu.serve.autoscaler import Autoscaler
+    from harp_tpu.utils.metrics import Metrics
+
+    if session is None:
+        from harp_tpu.session import HarpSession
+
+        session = HarpSession()
+    metrics = Metrics()
+    specs = {f"m{i}": {"kind": "topk", "num_users": num_users,
+                       "num_items": num_items, "rank": rank, "k": k,
+                       "seed": seed + i} for i in range(n_models)}
+    refs = {name: fleet_mod.topk_reference(
+        *fleet_mod.topk_factors(sp, 0), k) for name, sp in specs.items()}
+    own_tmp = None
+    aot_dir = None
+    prebuild_s = None
+    hashes = None
+    if prebuild_artifacts:
+        from harp_tpu.aot import serve_artifacts
+
+        own_tmp = tempfile.TemporaryDirectory(prefix="harp-bench-asc-aot-")
+        aot_dir = own_tmp.name
+        t0 = time.perf_counter()
+        fleet_mod.warm_artifacts(specs, aot_dir, session=session,
+                                 metrics=metrics)
+        prebuild_s = round(time.perf_counter() - t0, 3)
+        # the store is keyed by spec hash (warm_artifacts' convention):
+        # the fleet must look up under the same axis or nothing loads
+        hashes = {name: serve_artifacts.model_hash_from_spec(sp)
+                  for name, sp in specs.items()}
+    eps = {name: fleet_mod.build_endpoint(session, name, sp)
+           for name, sp in specs.items()}
+    workers, make_client = local_gang(
+        session, [eps], max_wait_s=0.005, max_queue=max_queue,
+        metrics=metrics, client_rank_base=1000)
+
+    def builder(name, version):
+        return fleet_mod.build_endpoint(session, name, specs[name],
+                                        version=version, restore=True)
+
+    fleet = fleet_mod.LocalFleet(workers, make_client,
+                                 endpoint_builder=builder,
+                                 metrics=metrics, aot_dir=aot_dir,
+                                 aot_model_hashes=hashes)
+    served: List[float] = []          # latencies of correct replies
+    errors: List[str] = []
+    wrong: List[tuple] = []
+    shed = [0]
+    lock = threading.Lock()
+    stop = threading.Event()
+    scenario_over = threading.Event()
+    t_start = time.perf_counter()
+    worker_traj: List[dict] = []      # change points of the worker count
+
+    def sampler() -> None:
+        last = None
+        while not scenario_over.is_set():
+            n = fleet.worker_count()
+            if n != last:
+                worker_traj.append(
+                    {"t_s": round(time.perf_counter() - t_start, 2),
+                     "workers": n})
+                last = n
+            time.sleep(0.02)
+
+    def load(ci: int) -> None:
+        client = fleet.make_client()
+        rng = np.random.default_rng(seed + 300 + ci)
+        try:
+            while not stop.is_set():
+                name = f"m{rng.integers(0, n_models)}"
+                u = int(rng.integers(0, num_users))
+                t0 = time.perf_counter()
+                try:
+                    res = client.request_retry(
+                        OP_TOPK, name, u, timeout=10.0, attempts=10,
+                        backoff_max_s=0.5, sync_timeout=2.0)
+                except protocol.ServeError as e:
+                    if str(e).startswith(protocol.ERR_OVERLOADED):
+                        with lock:      # clean shed: retry budget spent
+                            shed[0] += 1
+                    else:
+                        with lock:
+                            errors.append(f"{type(e).__name__}: {e}")
+                    continue
+                except Exception as e:  # noqa: BLE001 — tallied, asserted
+                    with lock:
+                        errors.append(f"{type(e).__name__}: {e}")
+                    continue
+                dt = time.perf_counter() - t0
+                with lock:
+                    served.append(dt)
+                    if res["items"] != refs[name][u]:
+                        wrong.append((name, u, res["items"]))
+        finally:
+            client.close()
+
+    warm = fleet.make_client()
+    try:
+        for name in specs:
+            warm.request_retry(OP_TOPK, name, 0, timeout=60.0)
+    finally:
+        warm.close()
+    asc = Autoscaler(fleet, metrics=metrics, poll_interval_s=0.05,
+                     up_depth=6.0, down_depth=0.5, up_streak=2,
+                     down_streak=10, cooldown_s=0.5,
+                     max_workers=max_workers, models_per_move=1)
+    sampler_t = threading.Thread(target=sampler, daemon=True,
+                                 name="harp-asc-bench-sampler")
+    threads = [threading.Thread(target=load, args=(ci,),
+                                name=f"harp-asc-bench-{ci}")
+               for ci in range(num_clients)]
+    peak = 1
+    try:
+        sampler_t.start()
+        for t in threads:
+            t.start()
+        # hold the ramp until the controller has grown the fleet (and at
+        # least ramp_hold_s so the grown shape actually serves traffic)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < ramp_timeout_s:
+            peak = max(peak, fleet.worker_count())
+            if peak >= 2 and time.monotonic() - t0 >= ramp_hold_s:
+                break
+            time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(30.0)
+        ramp_wall = time.monotonic() - t0
+        # ramp over: the controller must unwind the shape it built
+        t1 = time.monotonic()
+        while time.monotonic() - t1 < 30.0 and fleet.worker_count() > 1:
+            time.sleep(0.1)
+        t2 = time.monotonic()
+        while (time.monotonic() - t2 < 10.0
+               and not any(r["action"] == "scale-down"
+                           for r in asc.trajectory())):
+            time.sleep(0.05)
+    finally:
+        stop.set()
+        asc.close()
+        scenario_over.set()
+        sampler_t.join(5.0)
+    # the ramp loop stops sampling once it has seen growth; the sampler
+    # thread saw every change point, so the trajectory is the peak's truth
+    peak = max([peak] + [p["workers"] for p in worker_traj])
+    up_rec = next((r for r in fleet.journal.records
+                   if r["event"] == "scale-up"), None)
+    down_rec = next((r for r in fleet.journal.records
+                     if r["event"] == "scale-down"), None)
+    decisions = [{"t_s": r["t_s"], "action": r["action"],
+                  "workers": r.get("workers"),
+                  "total_depth": r.get("total_depth")}
+                 for r in asc.trajectory()]
+    final = fleet.worker_count()
+    fleet.close()
+    n = len(served)
+    snap = metrics.snapshot()["counters"]
+    row = {
+        "gang": f"1 worker + {num_clients} closed-loop clients over "
+                f"{n_models} models, max_queue={max_queue}, autoscaler "
+                f"up_depth=6/down_depth=0.5 cooldown=0.5s, "
+                f"max_workers={max_workers}",
+        "device": _device(),
+        "requests": n, "errors": len(errors),
+        "error_sample": errors[:3],
+        "wrong_results": len(wrong),
+        "shed_after_retries": shed[0],
+        "sheds_total": int(sum(v for k_, v in snap.items()
+                               if k_.startswith("serve.shed."))),
+        "qps": round(n / ramp_wall, 1) if ramp_wall else None,
+        **_percentiles(served),
+        "peak_workers": peak, "final_workers": final,
+        "worker_trajectory": worker_traj,
+        "decisions": decisions,
+        "scale_up": (None if up_rec is None else {
+            "rank": up_rec["rank"], "models": up_rec["models"],
+            "placement_version": up_rec["placement_version"],
+            "trace_counts": up_rec["trace_counts"],
+            "aot_loaded": up_rec["aot_loaded"]}),
+        "scale_down": (None if down_rec is None else {
+            "rank": down_rec["rank"], "moved": down_rec["moved"],
+            "placement_version": down_rec["placement_version"]}),
+        "aot": bool(aot_dir),
+        "prebuild_s": prebuild_s,
+    }
+    if row["device"] != "tpu":
+        row["note"] = ("cpu-mesh: the ramp prices router+batcher+dispatch "
+                       "with CPU dispatches; the controller reads the same "
+                       "gauges either way, the driver's on-chip run "
+                       "re-measures the latency split")
+    if own_tmp is not None:
+        own_tmp.cleanup()
+    return row
+
+
 def measure(session=None, *, recovery_kw: Optional[dict] = None,
             refresh_kw: Optional[dict] = None,
             hotkey_kw: Optional[dict] = None,
-            restart_kw: Optional[dict] = None) -> dict:
+            restart_kw: Optional[dict] = None,
+            autoscale_kw: Optional[dict] = None) -> dict:
     """All fleet rows (the ``bench.py --only serving`` extension);
     per-scenario kwargs forward to their measure_* functions. The ISSUE
     15 comparison rides as ``restart`` (cold start off/on artifacts) and
     ``recovery_aot`` (the scripted-kill recovery re-run with a pre-warmed
-    store — the elastic replacement loads instead of compiling)."""
+    store — the elastic replacement loads instead of compiling); the
+    ISSUE 16 ramp rides as ``autoscale``."""
     base_kw = dict(recovery_kw or {})
     # the baseline leg must stay artifact-free for the comparison to mean
     # anything, and the aot leg's override must not collide with a
@@ -694,4 +926,33 @@ def measure(session=None, *, recovery_kw: Optional[dict] = None,
         "refresh": measure_refresh(session, **(refresh_kw or {})),
         "hotkey": measure_hotkey(session, **(hotkey_kw or {})),
         "restart": measure_restart(**(restart_kw or {})),
+        "autoscale": measure_autoscale(session, **(autoscale_kw or {})),
     }
+
+
+def main(argv=None) -> None:
+    """Subprocess entry for the autoscale ramp: ``python -m
+    harp_tpu.benchmark.serving_fleet [--ramp_hold_s=N] [--mesh_workers=N]``
+    prints the :func:`measure_autoscale` row as the last stdout line.
+    bench.py spawns this on the 8-device virtual CPU mesh — the fleet
+    topology where the reshard-restore builder path and the AOT store's
+    traced layouts agree (the bench controller's own process may expose a
+    single device, where a restore-built table commits a replicated
+    layout and every artifact load would miss into a warm-compile)."""
+    import json
+    import sys
+
+    from harp_tpu.session import HarpSession
+
+    argv = sys.argv[1:] if argv is None else argv
+    kw = {}
+    for a in argv:
+        k, _, v = a.lstrip("-").partition("=")
+        kw[k] = float(v) if "." in v else int(v)
+    mesh_workers = int(kw.pop("mesh_workers", 8))
+    session = HarpSession(num_workers=mesh_workers)
+    print(json.dumps(measure_autoscale(session, **kw)))
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,35 @@ kinds
                      chip, sick link), not a death. Exercises the gang
                      telemetry straggler detector (harp_tpu.telemetry.gang),
                      which must flag the rank while it stays alive.
+    ``netdrop``      WIRE fault (ISSUE 16): the transport's Nth outbound
+                     frame (``request=N``, the per-transport FRAME clock —
+                     :func:`net_fire` is called by
+                     :meth:`~harp_tpu.parallel.p2p.P2PTransport.send` at
+                     every frame boundary) is silently eaten — the sender
+                     believes it sent, the receiver never sees it: the
+                     at-most-once delivery seam, now scriptable. Fires
+                     once per (spec, rank).
+    ``netdup``       the Nth outbound frame is sent TWICE — a retransmit
+                     seam: duplicate-reply idempotence at the client's
+                     futures map is what this exists to test. Once per
+                     (spec, rank).
+    ``netcorrupt``   the Nth outbound frame's BODY bytes are flipped (the
+                     length prefix stays intact): the receiver's decode
+                     guard must drop the frame and keep the connection —
+                     the recv-boundary half of the wire grammar. Once per
+                     (spec, rank).
+    ``netdelay``     every outbound frame from the Nth on is delayed
+                     ``ms`` milliseconds before the write — a sustained
+                     sick link (the wire twin of ``slow``).
+    ``netpart``      a DIRECTED partition: from the Nth frame on, every
+                     send from ``rank=R`` toward ``peer=P`` raises
+                     ConnectionError without touching the socket — rank R
+                     simply cannot reach P anymore (one direction only;
+                     script the mirrored spec for a full cut). This is
+                     what upgrades the VANISH flavor from injected-probe-
+                     tested to real-transport-tested: the client-side
+                     breaker/fast-fail machinery sees the same
+                     ConnectionError a dead NIC produces.
 
 keys
     ``epoch=N``   (required for training kinds) fire at the first iteration
@@ -57,7 +86,11 @@ keys
                   (sustained, same reasoning as the epoch flavor). A spec
                   carries ``epoch=`` or ``request=``, never both —
                   training boundaries and serving request streams are
-                  different clocks.
+                  different clocks. For the net kinds the same key counts
+                  the transport's OUTBOUND FRAMES instead (1-based, per
+                  :class:`~harp_tpu.parallel.p2p.P2PTransport`): a wire
+                  fault's natural boundary is the frame, and one request
+                  is one frame on each hop it crosses.
     ``rank=R``    only this gang member fires (HARP_PROCESS_ID for the
                   training boundary hook; the SERVING rank the router
                   passes to :func:`serve_fire` for request faults — an
@@ -74,8 +107,21 @@ keys
                   0 outside the supervisor). Default 0 — the fault fires on
                   the first launch and NOT again after a relaunch, which is
                   what makes "die once, recover, finish" scriptable.
-    ``ms=M``      ``slow`` only: the per-boundary sleep, milliseconds
-                  (default 100).
+    ``ms=M``      ``slow``/``netdelay`` only: the per-boundary (or
+                  per-frame) sleep, milliseconds (default 100).
+    ``peer=P``    ``netpart`` only (and required there): the DESTINATION
+                  rank this partition cuts toward. Range-checked like
+                  ``rank=``.
+
+Parse-time loudness (ISSUE 16 satellite): qualifiers a kind cannot carry
+(``ms=`` off slow/netdelay, ``epoch=`` on a wire kind, ``peer=`` off
+netpart) are rejected when the spec is parsed, on every boundary — a
+scripted scenario with a meaningless qualifier must fail the job, not
+silently run fault-free. ``rank=``/``peer=`` range checks cover the
+SERVING gang too: request-clock specs are bounded by the serving world
+size when it is known (``HARP_SERVE_WORLD``, set by the fleet spawner, or
+an explicit ``serve_world_size=`` to :func:`parse_faults`), falling back
+to the training world (HARP_NUM_PROCESSES) otherwise.
 
 The hooks are checked host-side between compiled chunks (the models'
 ``fit_checkpointed`` loops), never inside XLA programs: a fault can only
@@ -95,11 +141,23 @@ FAULT_VANISH_EXIT = 86     # scripted "host gone": member exits and the
 #                            supervisor must treat its HOST as unreachable
 #                            (re-place onto a spare / shrink, never relaunch
 #                            onto it)
-_KINDS = ("crash", "kill", "vanish", "hang", "ckpt-corrupt", "slow")
+# wire kinds (ISSUE 16): fired by the transport at frame send boundaries
+# (net_fire); request= counts OUTBOUND FRAMES for these
+_NET_KINDS = ("netdrop", "netdelay", "netdup", "netcorrupt", "netpart")
+_KINDS = ("crash", "kill", "vanish", "hang", "ckpt-corrupt",
+          "slow") + _NET_KINDS
 # kinds that may ride the serving request clock (request=N); kill is
 # serving-ONLY — the training twin is crash@epoch=
 _SERVE_KINDS = ("kill", "vanish", "slow")
+# kinds whose sustained flavor carries a per-boundary sleep
+_MS_KINDS = ("slow", "netdelay")
 SLOW_DEFAULT_MS = 100
+
+
+class NetPartitioned(ConnectionError):
+    """Raised by :func:`net_fire` when a ``netpart`` spec cuts this send:
+    the transport surfaces it as the same ConnectionError a dead NIC
+    produces (it IS one — a ConnectionError subclass)."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -108,27 +166,41 @@ class FaultSpec:
     epoch: Optional[int] = None     # training trigger (iteration boundary)
     rank: Optional[int] = None      # None = every rank
     attempt: int = 0
-    ms: int = SLOW_DEFAULT_MS       # slow only: per-boundary sleep
-    request: Optional[int] = None   # serving trigger (Nth received request)
+    ms: int = SLOW_DEFAULT_MS       # slow/netdelay only: per-boundary sleep
+    request: Optional[int] = None   # serving trigger (Nth received request;
+    #                                 Nth outbound frame for net kinds)
+    peer: Optional[int] = None      # netpart only: partitioned-toward rank
+
+
+def _serve_world(serve_world_size: Optional[int]) -> Optional[int]:
+    if serve_world_size is not None:
+        return serve_world_size
+    env = os.environ.get("HARP_SERVE_WORLD")
+    return int(env) if env else None
 
 
 def parse_faults(text: str,
-                 world_size: Optional[int] = None) -> List[FaultSpec]:
+                 world_size: Optional[int] = None,
+                 serve_world_size: Optional[int] = None) -> List[FaultSpec]:
     """Parse the ``HARP_FAULT`` grammar; raises ValueError with the offending
     token so a typo fails the job loudly instead of silently not injecting.
 
     ``world_size`` (default: HARP_NUM_PROCESSES when the gang launcher set
     it) bounds ``rank=``: a spec naming rank >= world size could never fire
     — reject it at parse time, on every boundary, instead of letting the
-    scripted scenario silently run fault-free. Exemption: a spec already
-    DISARMED by attempt gating (its ``attempt`` != HARP_GANG_ATTEMPT) is
-    not range-checked — after the supervisor shrinks the gang, the very
-    spec that vanished the old top rank is still in the environment of the
-    smaller relaunch, and bricking that relaunch would defeat the
-    re-placement it scripted."""
+    scripted scenario silently run fault-free. Request-clock specs (the
+    serving and wire kinds) are bounded by the SERVING world instead when
+    it is known (``serve_world_size=`` or HARP_SERVE_WORLD — the fleet
+    spawner exports it), since an in-process serving gang's ranks are not
+    the training gang's. Exemption: a spec already DISARMED by attempt
+    gating (its ``attempt`` != HARP_GANG_ATTEMPT) is not range-checked —
+    after the supervisor shrinks the gang, the very spec that vanished the
+    old top rank is still in the environment of the smaller relaunch, and
+    bricking that relaunch would defeat the re-placement it scripted."""
     if world_size is None:
         env_world = os.environ.get("HARP_NUM_PROCESSES")
         world_size = int(env_world) if env_world else None
+    serve_world = _serve_world(serve_world_size)
     cur_attempt = int(os.environ.get("HARP_GANG_ATTEMPT", "0"))
     specs = []
     for part in filter(None, (p.strip() for p in text.split(","))):
@@ -141,10 +213,11 @@ def parse_faults(text: str,
         for item in filter(None, argstr.split(":")):
             key, eq, val = item.partition("=")
             if not eq or key not in ("epoch", "rank", "attempt", "ms",
-                                     "request"):
+                                     "request", "peer"):
                 raise ValueError(f"fault spec {part!r}: bad argument "
                                  f"{item!r} "
-                                 f"(epoch=/request=/rank=/attempt=/ms=)")
+                                 f"(epoch=/request=/rank=/attempt=/ms=/"
+                                 f"peer=)")
             try:
                 kv[key] = int(val)
             except ValueError:
@@ -153,34 +226,53 @@ def parse_faults(text: str,
         if ("epoch" in kv) == ("request" in kv):
             raise ValueError(f"fault spec {part!r}: exactly one of epoch= "
                              f"(training boundary) or request= (serving "
-                             f"request) is required")
-        if "request" in kv and kind not in _SERVE_KINDS:
+                             f"request / outbound frame) is required")
+        if "request" in kv and kind not in _SERVE_KINDS + _NET_KINDS:
             raise ValueError(f"fault spec {part!r}: request= applies to "
-                             f"serving kinds {_SERVE_KINDS} only")
+                             f"serving kinds {_SERVE_KINDS} and wire kinds "
+                             f"{_NET_KINDS} only")
+        if "epoch" in kv and kind in _NET_KINDS:
+            raise ValueError(f"fault spec {part!r}: wire kinds ride the "
+                             f"frame clock — request=N, never epoch=")
         if kind == "kill" and "request" not in kv:
             raise ValueError(f"fault spec {part!r}: kill is the serving "
                              f"kind — it needs request=N (training deaths "
                              f"are crash@epoch=)")
         if "request" in kv and kv["request"] < 1:
             raise ValueError(f"fault spec {part!r}: request= is 1-based")
-        if "ms" in kv and kind != "slow":
-            raise ValueError(f"fault spec {part!r}: ms= applies to slow "
-                             f"faults only")
-        rank = kv.get("rank")
+        if "ms" in kv and kind not in _MS_KINDS:
+            raise ValueError(f"fault spec {part!r}: ms= applies to "
+                             f"{'/'.join(_MS_KINDS)} faults only")
+        if "peer" in kv and kind != "netpart":
+            raise ValueError(f"fault spec {part!r}: peer= applies to "
+                             f"netpart only (the partitioned-toward rank)")
+        if kind == "netpart" and "peer" not in kv:
+            raise ValueError(f"fault spec {part!r}: netpart is a DIRECTED "
+                             f"partition — it needs peer=P (the rank the "
+                             f"cut points toward)")
         armed = kv.get("attempt", 0) == cur_attempt
-        if rank is not None and (rank < 0 or (world_size is not None
-                                              and armed
-                                              and rank >= world_size)):
-            bound = (f"world size {world_size} (valid ranks 0.."
-                     f"{world_size - 1})" if world_size is not None
-                     else "any gang")
-            raise ValueError(
-                f"fault spec {part!r}: rank={rank} is out of range for "
-                f"{bound} — this fault could never fire")
+        # request-clock specs live in the SERVING gang's rank space when
+        # the fleet told us its width; epoch-clock specs in the training
+        # gang's
+        bound_world = (serve_world if "request" in kv and serve_world
+                       is not None else world_size)
+        bound_name = ("serving world" if "request" in kv and serve_world
+                      is not None else "world")
+        for key in ("rank", "peer"):
+            r = kv.get(key)
+            if r is not None and (r < 0 or (bound_world is not None
+                                            and armed
+                                            and r >= bound_world)):
+                bound = (f"{bound_name} size {bound_world} (valid ranks "
+                         f"0..{bound_world - 1})" if bound_world is not None
+                         else "any gang")
+                raise ValueError(
+                    f"fault spec {part!r}: {key}={r} is out of range for "
+                    f"{bound} — this fault could never fire")
         specs.append(FaultSpec(kind, kv.get("epoch"), kv.get("rank"),
                                kv.get("attempt", 0),
                                kv.get("ms", SLOW_DEFAULT_MS),
-                               kv.get("request")))
+                               kv.get("request"), kv.get("peer")))
     return specs
 
 
@@ -273,6 +365,8 @@ def serve_fire(n_request: int, *, rank: int,
     for spec in specs:
         if spec.request is None or spec.attempt != attempt:
             continue
+        if spec.kind in _NET_KINDS:
+            continue                 # wire specs ride net_fire()
         if spec.rank is not None and spec.rank != rank:
             continue
         if n_request < spec.request:
@@ -302,6 +396,66 @@ def serve_fire(n_request: int, *, rank: int,
                 on_vanish()
             else:
                 os._exit(FAULT_VANISH_EXIT)
+
+
+def net_fire(n_frame: int, *, rank: int, dest: int,
+             sleep=time.sleep) -> List[str]:
+    """Frame-boundary hook for the WIRE fault grammar (ISSUE 16): the p2p
+    transport calls this with its 1-based outbound-frame counter, its own
+    rank, and the destination rank, for every frame that would touch a
+    socket (self-sends never hit the wire and never fire).
+
+    Returns the one-shot actions the transport must apply to THIS frame —
+    any of ``"drop"`` / ``"dup"`` / ``"corrupt"`` (each fires at most once
+    per (spec, rank): deterministic single faults, scriptable like
+    ``kill@request=N``). Sustained effects execute here: ``netdelay``
+    sleeps ``ms`` per frame from frame N on; ``netpart`` raises
+    :class:`NetPartitioned` (a ConnectionError) for every frame toward
+    ``peer=`` from frame N on — the caller's normal transport-failure
+    handling takes it from there."""
+    specs = _plan()
+    if not specs:
+        return []
+    attempt = _attempt()
+    actions: List[str] = []
+    for spec in specs:
+        if spec.kind not in _NET_KINDS or spec.request is None \
+                or spec.attempt != attempt:
+            continue
+        if spec.rank is not None and spec.rank != rank:
+            continue
+        if n_frame < spec.request:
+            continue
+        key = (spec, rank)
+        if spec.kind == "netdelay":
+            # sustained sick link: announce once, drag every frame
+            if key not in _printed:
+                _printed.add(key)
+                print(f"harp_tpu.faults: wire delay netdelay@request="
+                      f"{spec.request} ms={spec.ms} (rank {rank}) — every "
+                      f"frame from here", file=sys.stderr, flush=True)
+            sleep(spec.ms / 1000.0)
+            continue
+        if spec.kind == "netpart":
+            if spec.peer != dest:
+                continue             # the cut is directed — other peers
+            #                          stay reachable
+            if key not in _printed:
+                _printed.add(key)
+                print(f"harp_tpu.faults: partition netpart@request="
+                      f"{spec.request} rank {rank} -/-> peer {dest} — "
+                      f"sustained", file=sys.stderr, flush=True)
+            raise NetPartitioned(
+                f"scripted netpart: rank {rank} cannot reach {dest}")
+        if key in _fired:
+            continue
+        _fired.add(key)
+        print(f"harp_tpu.faults: firing {spec.kind}@request={spec.request} "
+              f"(rank {rank}, frame {n_frame} -> {dest})",
+              file=sys.stderr, flush=True)
+        actions.append({"netdrop": "drop", "netdup": "dup",
+                        "netcorrupt": "corrupt"}[spec.kind])
+    return actions
 
 
 def _execute(spec: FaultSpec, checkpointer) -> None:

@@ -333,7 +333,14 @@ def spawn_gang(num_processes: int = 2, devices_per_process: int = 4,
         port = s.getsockname()[1]
     env = {**os.environ, "JAX_PLATFORMS": "cpu",
            "XLA_FLAGS":
-           f"--xla_force_host_platform_device_count={devices_per_process}"}
+           f"--xla_force_host_platform_device_count={devices_per_process}",
+           # N members share ONE host core here (see test_three_process_gang:
+           # member skew is minutes) — a device probe parked behind a
+           # concurrent compile or a blocking Gloo collective is starvation,
+           # not a dead device, so the gang watchdog gets a deadline sized
+           # to the topology instead of the 60 s production default
+           "HARP_WATCHDOG_TIMEOUT": os.environ.get(
+               "HARP_WATCHDOG_TIMEOUT", "300")}
     root = repo_root or os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     procs = [subprocess.Popen(

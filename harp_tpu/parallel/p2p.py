@@ -55,6 +55,7 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
+from harp_tpu.parallel import faults as _faults
 from harp_tpu.parallel.events import Event, EventQueue, EventType
 
 _LEN = struct.Struct(">Q")
@@ -168,6 +169,10 @@ class P2PTransport:
         self._send_locks: Dict[int, threading.Lock] = {}
         self._retries = retries
         self._retry_sleep_s = retry_sleep_s
+        # outbound-frame clock for the wire fault grammar (ISSUE 16):
+        # counts frames that would touch a socket (self-sends excluded);
+        # bumped under _lock — send() runs on any caller thread
+        self._frames_out = 0
         self._connect_timeout_s = connect_timeout_s
         self._closed = False
         kv = _kv_client()
@@ -374,16 +379,40 @@ class P2PTransport:
         connection on socket failure (SMALL_RETRY_COUNT parity, scaled to
         control-plane rates). Thread-safe: sends to the same dest are
         serialized on a per-dest lock so concurrent frames never interleave
-        on the pooled connection."""
+        on the pooled connection.
+
+        Wire fault boundary (ISSUE 16): every frame that would touch a
+        socket first passes the ``HARP_FAULT`` net grammar
+        (:func:`~harp_tpu.parallel.faults.net_fire` — netdrop eats the
+        frame after a successful-looking send, netdup writes it twice,
+        netcorrupt flips its body bytes so the receiver's decode guard
+        drops it, netdelay drags the write, netpart raises the same
+        ConnectionError a dead NIC would). Self-sends never hit the wire
+        and never fire."""
         if self._closed:
             raise ConnectionError("transport is closed")
         if dest == self.rank:
             self.queue.put(Event(EventType.MESSAGE, self.rank, payload))
             return
+        with self._lock:
+            self._frames_out += 1
+            n_frame = self._frames_out
+        # NetPartitioned (a ConnectionError) propagates to the caller's
+        # normal transport-failure handling — that is the point
+        actions = _faults.net_fire(n_frame, rank=self.rank, dest=dest)
+        if "drop" in actions:
+            return                   # the wire ate it; at-most-once honored
         body = pickle.dumps((self.rank, payload))
+        if "corrupt" in actions:
+            # damage the BODY only: the length prefix stays true, so the
+            # receiver reads one intact frame boundary and its unpickle
+            # guard drops the garbage without losing the connection
+            body = bytes(b ^ 0xFF for b in body)
         frame = _LEN.pack(len(body)) + body
         with self._dest_lock(dest):
             self._send_framed(dest, frame)
+            if "dup" in actions:
+                self._send_framed(dest, frame)
 
     def _send_framed(self, dest: int, frame: bytes) -> None:
         last: Optional[Exception] = None

@@ -84,6 +84,7 @@ compilation cache (``compile_cache_dir=``) ride the same surfaces.
 
 from __future__ import annotations
 
+from harp_tpu.serve.autoscaler import Autoscaler
 from harp_tpu.serve.batcher import MicroBatcher, suggest_max_wait_s
 from harp_tpu.serve.cache import TopKReplyCache
 from harp_tpu.serve.endpoints import (ClassifyEndpoint, Endpoint,
@@ -99,6 +100,7 @@ from harp_tpu.serve.protocol import (OP_CLASSIFY, OP_TOPK, ServeError,
 from harp_tpu.serve.router import RouterClient, ServeWorker, local_gang
 
 __all__ = [
+    "Autoscaler",
     "ClassifyEndpoint", "Endpoint", "MicroBatcher", "OP_CLASSIFY", "OP_TOPK",
     "RouterClient", "ServeError", "ServeWorker", "TopKEndpoint",
     "TopKReplyCache", "classify_from_forest", "classify_from_linear_svm",

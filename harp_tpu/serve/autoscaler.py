@@ -1,0 +1,242 @@
+"""Demand-driven autoscaler — the controller that closes the elasticity
+loop (ISSUE 16, the ROADMAP's "grow/shrink the worker set through the
+existing spare-pool + versioned-placement machinery, driven by load
+instead of death").
+
+A :class:`Autoscaler` is one daemon thread polling the SAME metrics
+registry the gang exporter serves (``/snapshot`` is just
+``Metrics.snapshot()`` — the controller reads the source, a remote
+deployment would scrape the HTTP surface and see identical numbers):
+
+* ``serve.queue_depth.<model>`` gauges — the instantaneous per-model
+  backlog (kept honest on drain by the batcher, not just on submit);
+* ``serve.shed.<model>`` counters — admission-control refusals since the
+  last poll (a non-zero delta means clients are ALREADY being turned
+  away: the strongest overload signal);
+* ``slo.burning`` gauge — the PR 12 watchdog's live burn state;
+* ``serve.served.<model>`` counters — the QPS estimate journaled with
+  every decision, so an operator reading the journal sees WHAT load the
+  controller saw, not just what it did.
+
+Policy (deliberately boring — hysteresis + cooldown, no prediction):
+
+* **scale up** when the overload signal (total depth >= ``up_depth``, or
+  any shed delta, or a burning SLO) holds for ``up_streak`` consecutive
+  polls: mint one worker via :meth:`LocalFleet.scale_up` and move the
+  hottest ``models_per_move`` models from the most-loaded multi-model
+  worker onto it. A fleet where no donor owns two models has nothing to
+  split — the skip is journaled, not silent.
+* **scale down** when the idle signal (total depth <= ``down_depth``, no
+  sheds, no burn) holds for ``down_streak`` polls: retire the
+  highest-ranked worker above ``min_workers`` (LIFO — scaled-up workers
+  leave first), its models re-homed through the same builder path.
+* ``cooldown_s`` after EITHER move suppresses the next decision: a fresh
+  worker needs at least one poll interval of traffic before its effect
+  on the gauges is real, and flapping (up, down, up...) costs a restore
+  per flap.
+
+Both moves land through :class:`~harp_tpu.serve.fleet.LocalFleet`'s
+versioned-placement push — the path chaos recovery already exercises —
+and are journaled there (``scale-up``/``scale-down`` records with
+placement versions and AOT trace counts). The controller adds its own
+``autoscale-decision`` journal records and ``fleet.autoscale.*``
+counters, and keeps an in-memory :attr:`events` trajectory (worker count
+over time) the bench's ramp row asserts against.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+DEFAULT_UP_DEPTH = 8.0
+DEFAULT_DOWN_DEPTH = 1.0
+DEFAULT_UP_STREAK = 2
+DEFAULT_DOWN_STREAK = 8
+DEFAULT_COOLDOWN_S = 1.0
+
+
+class Autoscaler:
+    """Poll the gang's gauges, drive ``fleet.scale_up``/``scale_down``.
+
+    ``fleet`` is a :class:`~harp_tpu.serve.fleet.LocalFleet` constructed
+    with an ``endpoint_builder`` (the moves need it). ``metrics``
+    defaults to the fleet's registry — the in-process gang writes its
+    gauges there. ``max_workers``/``min_workers`` bound the fleet size;
+    the rest of the knobs are the policy above."""
+
+    def __init__(self, fleet, *, metrics=None,
+                 poll_interval_s: float = 0.1,
+                 up_depth: float = DEFAULT_UP_DEPTH,
+                 down_depth: float = DEFAULT_DOWN_DEPTH,
+                 up_streak: int = DEFAULT_UP_STREAK,
+                 down_streak: int = DEFAULT_DOWN_STREAK,
+                 cooldown_s: float = DEFAULT_COOLDOWN_S,
+                 min_workers: int = 1, max_workers: int = 4,
+                 models_per_move: int = 1):
+        self.fleet = fleet
+        self.metrics = metrics if metrics is not None else fleet.metrics
+        self.poll_interval_s = float(poll_interval_s)
+        self.up_depth = float(up_depth)
+        self.down_depth = float(down_depth)
+        self.up_streak = max(1, int(up_streak))
+        self.down_streak = max(1, int(down_streak))
+        self.cooldown_s = float(cooldown_s)
+        self.min_workers = max(1, int(min_workers))
+        self.max_workers = max(self.min_workers, int(max_workers))
+        self.models_per_move = max(1, int(models_per_move))
+        # decision state: only the controller thread writes these, but
+        # events/errors are read from test/bench threads — guarded
+        self._lock = threading.Lock()
+        self.events: List[dict] = []
+        self._up = 0
+        self._down = 0
+        self._last_move = 0.0
+        self._last_served: Optional[float] = None
+        self._last_shed: Optional[float] = None
+        self._t0 = time.monotonic()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="harp-serve-autoscaler")
+        self._thread.start()
+
+    # -- signal extraction --------------------------------------------------
+
+    def _read_signals(self) -> dict:
+        snap = self.metrics.snapshot()
+        gauges = snap.get("gauges", {})
+        counters = snap.get("counters", {})
+        depths: Dict[str, float] = {
+            k[len("serve.queue_depth."):]: float(v)
+            for k, v in gauges.items()
+            if k.startswith("serve.queue_depth.")}
+        served = sum(v for k, v in counters.items()
+                     if k.startswith("serve.served."))
+        shed = sum(v for k, v in counters.items()
+                   if k.startswith("serve.shed."))
+        served_delta = (served - self._last_served
+                        if self._last_served is not None else 0.0)
+        shed_delta = (shed - self._last_shed
+                      if self._last_shed is not None else 0.0)
+        self._last_served, self._last_shed = served, shed
+        return {
+            "depths": depths,
+            "total_depth": sum(depths.values()),
+            "shed_delta": shed_delta,
+            "served_delta": served_delta,
+            "burning": float(gauges.get("slo.burning", 0.0)) >= 1.0,
+        }
+
+    # -- decision loop ------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self._tick()
+            except (RuntimeError, ValueError, OSError,
+                    ConnectionError, TimeoutError) as e:
+                # a failed move (builder error, drain timeout) must not
+                # kill the controller — journal it and keep watching; the
+                # cooldown it set prevents an immediate identical retry
+                self._record({"action": "error", "error": repr(e)})
+                self.metrics.count("fleet.autoscale.errors")
+
+    def _tick(self) -> None:
+        sig = self._read_signals()
+        overload = (sig["total_depth"] >= self.up_depth
+                    or sig["shed_delta"] > 0 or sig["burning"])
+        idle = (sig["total_depth"] <= self.down_depth
+                and sig["shed_delta"] == 0 and not sig["burning"])
+        # hysteresis: streaks reset the moment the signal breaks, so one
+        # noisy poll cannot trigger a move
+        self._up = self._up + 1 if overload else 0
+        self._down = self._down + 1 if idle else 0
+        self.metrics.gauge("fleet.autoscale.up_streak", self._up)
+        self.metrics.gauge("fleet.autoscale.down_streak", self._down)
+        if time.monotonic() - self._last_move < self.cooldown_s:
+            return
+        n = self.fleet.worker_count()
+        if self._up >= self.up_streak and n < self.max_workers:
+            self._scale_up(sig, n)
+        elif self._down >= self.down_streak and n > self.min_workers:
+            self._scale_down(sig, n)
+
+    def _pick_move(self, depths: Dict[str, float]) -> Optional[List[str]]:
+        """The hottest ``models_per_move`` models on the most-loaded
+        worker that owns more than one — a single-model worker cannot be
+        split (placement maps each model to exactly one rank)."""
+        by_worker: Dict[int, List[str]] = {}
+        placement = dict(self.fleet.placement)
+        for m, r in placement.items():
+            by_worker.setdefault(r, []).append(m)
+        donors = [(sum(depths.get(m, 0.0) for m in ms), r, ms)
+                  for r, ms in by_worker.items() if len(ms) > 1]
+        if not donors:
+            return None
+        _load, _rank, ms = max(donors)
+        ms = sorted(ms, key=lambda m: -depths.get(m, 0.0))
+        # never strip a donor bare — it must keep at least one model
+        take = min(self.models_per_move, len(ms) - 1)
+        return ms[:take] if take > 0 else None
+
+    def _scale_up(self, sig: dict, n: int) -> None:
+        models = self._pick_move(sig["depths"])
+        if models is None:
+            self._record({"action": "skip-up",
+                          "reason": "no multi-model donor to split",
+                          "workers": n, **self._sig_brief(sig)})
+            self._up = 0     # re-arm: the fleet shape won't change alone
+            return
+        worker = self.fleet.scale_up(models)
+        self._after_move("up", {"rank": worker.rank, "models": models,
+                                "workers": n + 1, **self._sig_brief(sig)})
+
+    def _scale_down(self, sig: dict, n: int) -> None:
+        # LIFO: the most recently minted worker retires first, so a ramp
+        # that subsides unwinds exactly the shape the ramp built
+        victim = max(r for r in
+                     (w.rank for w in self.fleet.workers()))
+        moved = self.fleet.scale_down(victim)
+        self._after_move("down", {"rank": victim, "moved": moved,
+                                  "workers": n - 1,
+                                  **self._sig_brief(sig)})
+
+    @staticmethod
+    def _sig_brief(sig: dict) -> dict:
+        return {"total_depth": round(sig["total_depth"], 1),
+                "shed_delta": sig["shed_delta"],
+                "served_delta": sig["served_delta"],
+                "burning": sig["burning"]}
+
+    def _after_move(self, direction: str, detail: dict) -> None:
+        self._up = self._down = 0
+        self._last_move = time.monotonic()
+        self.metrics.count(f"fleet.autoscale.{direction}")
+        self._record({"action": f"scale-{direction}", **detail})
+
+    def _record(self, detail: dict) -> None:
+        rec = {"event": "autoscale-decision",
+               "t_s": round(time.monotonic() - self._t0, 3), **detail}
+        with self._lock:
+            self.events.append(rec)
+        self.fleet._journal(rec)
+
+    # -- surface ------------------------------------------------------------
+
+    def trajectory(self) -> List[dict]:
+        """Every decision (moves, skips, errors) with its relative
+        timestamp and the worker count after it — the bench's ramp row
+        asserts the count follows the load up AND back down."""
+        with self._lock:
+            return list(self.events)
+
+    def close(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        self._thread.join(timeout)
+
+    def __enter__(self) -> "Autoscaler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

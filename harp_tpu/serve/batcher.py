@@ -18,6 +18,22 @@ Shutdown contract (the PR 7 atexit-close contract extended to serving):
 ``drain_and_stop`` refuses new submissions, serves everything already
 accepted (the in-flight micro-batch drains), then joins the thread. The
 router replies "shutting-down" to anything refused.
+
+Admission control (ISSUE 16): ``max_queue`` bounds the pending deque —
+a submit that would push the backlog past the bound is SHED with a
+retryable ``overloaded`` reply instead of queued, and the reply carries
+``retry_after_s`` computed from the backlog depth x the observed (EWMA)
+dispatch wall, so a well-behaved client backs off exactly as long as the
+queue needs to drain rather than guessing. ``brownout_fn`` (wired to the
+SLO watchdog's burning state by the worker) sheds sub-``brownout_min_
+priority`` traffic even while the queue is within bounds — the cheapest
+load to drop is the load that was declared droppable. The default
+threshold (0) sheds nothing at default priority: brownout only drops
+traffic an operator marked droppable (raised threshold or negative
+request priority). A request that is
+both past its ``deadline_ts`` AND facing a full queue gets exactly ONE
+reply: deadline-exceeded wins (shedding an already-dead request as
+"retryable" would invite a pointless resubmit).
 """
 
 from __future__ import annotations
@@ -72,7 +88,10 @@ class MicroBatcher:
 
     def __init__(self, endpoint, reply_fn: Callable, *,
                  max_wait_s: float = DEFAULT_MAX_WAIT_S,
-                 max_batch: Optional[int] = None, metrics=None):
+                 max_batch: Optional[int] = None, metrics=None,
+                 max_queue: Optional[int] = None,
+                 brownout_fn: Optional[Callable[[], bool]] = None,
+                 brownout_min_priority: int = 0):
         if metrics is None:
             from harp_tpu.utils.metrics import DEFAULT as metrics
         self.endpoint = endpoint
@@ -80,10 +99,19 @@ class MicroBatcher:
         self.max_wait_s = max_wait_s
         self.max_batch = min(max_batch or endpoint.max_batch,
                              endpoint.max_batch)
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.max_queue = max_queue
+        self.brownout_fn = brownout_fn
+        self.brownout_min_priority = brownout_min_priority
         self.metrics = metrics
         self.queue_high_watermark = 0
         self._pending: collections.deque = collections.deque()
         self._cv = threading.Condition()
+        # EWMA of one dispatch's wall clock (seconds), written by the
+        # batcher thread under _cv, read by submit() for retry_after_s;
+        # None until the first dispatch lands (fall back to max_wait_s)
+        self._dispatch_ewma: Optional[float] = None
         self._stopping = False
         self._stopped = threading.Event()
         self._thread = threading.Thread(
@@ -95,31 +123,96 @@ class MicroBatcher:
         with self._cv:
             return len(self._pending)
 
+    def _retry_after_locked(self, depth: int) -> float:
+        """How long the current backlog needs to drain: full coalescing
+        windows to chew through ``depth`` requests at ``max_batch`` per
+        dispatch, each costing the observed (EWMA) dispatch wall, plus one
+        more window for the retry itself to coalesce. Called under _cv."""
+        per = (self._dispatch_ewma if self._dispatch_ewma is not None
+               else self.max_wait_s)
+        windows = max(1, -(-depth // self.max_batch))  # ceil-div
+        return windows * per + self.max_wait_s
+
     def submit(self, msg: dict) -> bool:
         """Accept one request for coalescing; False once stopping (the
-        caller replies shutting-down)."""
+        caller replies shutting-down). A shed or already-expired request
+        returns True — it was HANDLED (exactly one reply sent here), the
+        caller must not reply again."""
+        now = time.time()
+        dl = msg.get("deadline_ts")
+        expired = dl is not None and now > dl
+        shed = None  # None | "brownout" | "queue"
         with self._cv:
             if self._stopping:
                 return False
-            spans.stamp(msg, spans.ENQUEUE)
-            self._pending.append((msg, time.perf_counter()))
+            if not expired:
+                # brownout outranks the queue bound: while the SLO
+                # watchdog burns, droppable-priority traffic is shed even
+                # from a healthy queue (hot-key cache hits never reach
+                # here — the worker serves them before admission)
+                if (self.brownout_fn is not None
+                        and int(msg.get("priority") or 0)
+                        < self.brownout_min_priority
+                        and self.brownout_fn()):
+                    shed = "brownout"
+                elif (self.max_queue is not None
+                      and len(self._pending) >= self.max_queue):
+                    shed = "queue"
+            if not expired and shed is None:
+                spans.stamp(msg, spans.ENQUEUE)
+                self._pending.append((msg, time.perf_counter()))
             depth = len(self._pending)
-            self._cv.notify()
+            retry_after = self._retry_after_locked(depth)
+            if shed is None and not expired:
+                self._cv.notify()
+        name = self.endpoint.name
+        if expired:
+            # deadline-vs-shed: the deadline WINS and is the ONLY reply —
+            # an already-dead request shed as "retryable" would invite a
+            # pointless resubmit of work nobody is waiting for
+            age_ms = (now - msg["ts"]) * 1e3 if isinstance(
+                msg.get("ts"), (int, float)) else None
+            over_ms = (now - dl) * 1e3
+            self._safe_reply(
+                msg, ok=False,
+                error=f"{protocol.ERR_DEADLINE}: request age "
+                      f"{age_ms:.1f} ms missed deadline by {over_ms:.1f} ms"
+                      f" (batcher max_wait_s={self.max_wait_s}; expired "
+                      f"before admission)"
+                if age_ms is not None else
+                f"{protocol.ERR_DEADLINE}: missed deadline by "
+                f"{over_ms:.1f} ms (batcher max_wait_s={self.max_wait_s}; "
+                f"expired before admission)")
+            self.metrics.count(f"serve.deadline_expired.{name}")
+            return True
+        if shed is not None:
+            self._safe_reply(
+                msg, ok=False,
+                error=f"{protocol.ERR_OVERLOADED}: {shed} shed at depth "
+                      f"{depth} (max_queue={self.max_queue}), retry in "
+                      f"~{retry_after:.3f}s",
+                retry_after_s=retry_after)
+            self.metrics.count(f"serve.shed.{name}")
+            if shed == "brownout":
+                self.metrics.count(f"serve.brownout_shed.{name}")
+            self.metrics.gauge(f"serve.shedding.{name}", 1)
+            return True
         # PRE-dispatch queue visibility (the post-dispatch occupancy gauge
         # cannot see growth under overload: a queue building faster than
         # dispatches drain it looks exactly like healthy coalescing there).
         # The depth gauge is the instantaneous backlog; the high watermark
         # only ever rises, so a past overload stays visible in a scrape.
-        self.metrics.gauge(f"serve.queue_depth.{self.endpoint.name}", depth)
+        self.metrics.gauge(f"serve.queue_depth.{name}", depth)
+        self.metrics.gauge(f"serve.shedding.{name}", 0)
         if depth > self.queue_high_watermark:
             self.queue_high_watermark = depth
             self.metrics.gauge(
-                f"serve.queue_high_watermark.{self.endpoint.name}", depth)
+                f"serve.queue_high_watermark.{name}", depth)
         if depth > self.max_batch:
             # more waiting than one dispatch can take = overload by
             # definition; count every such submit so the overload DURATION
             # is visible, not just its peak
-            self.metrics.count(f"serve.queue_overfull.{self.endpoint.name}")
+            self.metrics.count(f"serve.queue_overfull.{name}")
         return True
 
     # ------------------------------------------------------------------ #
@@ -146,6 +239,13 @@ class MicroBatcher:
                     take = [self._pending.popleft()
                             for _ in range(min(len(self._pending),
                                                self.max_batch))]
+                    depth = len(self._pending)
+                # refresh the depth gauge as the queue DRAINS too: the
+                # autoscaler's scale-down trigger reads this gauge, and a
+                # gauge only written on submit would freeze at its last
+                # pre-idle value forever once traffic stops
+                self.metrics.gauge(
+                    f"serve.queue_depth.{self.endpoint.name}", depth)
                 self._dispatch(take)
         finally:
             self._stopped.set()
@@ -232,6 +332,12 @@ class MicroBatcher:
             self.metrics.count(f"serve.dispatch_errors.{self.endpoint.name}")
             return
         wall = time.perf_counter() - t0
+        with self._cv:
+            # EWMA (alpha=0.3) of one dispatch's wall — submit() turns it
+            # into retry_after_s for shed replies
+            self._dispatch_ewma = (wall if self._dispatch_ewma is None
+                                   else 0.7 * self._dispatch_ewma
+                                   + 0.3 * wall)
         for m in live:
             spans.stamp(m, spans.DISPATCH_END)
         n = len(live)

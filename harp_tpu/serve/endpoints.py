@@ -46,11 +46,26 @@ import numpy as np
 from harp_tpu import keyval
 from harp_tpu.session import HarpSession
 
+# ONE process-wide gate serializing collective device programs (ISSUE 16).
+# The in-process gang shares a single virtual mesh: two collective programs
+# (top-k's all_to_all dispatches, the reshard engine's rounds) launched
+# concurrently can each hold a subset of the runtime's participant threads
+# while waiting for the other's to arrive at rendezvous — a deadlock, not a
+# slowdown (observed the moment multiple top-k batcher threads dispatch at
+# once). Collective-free programs (classify) never rendezvous and stay
+# un-gated. RLock: restore_full takes it once and per-shard restores nest.
+# Ordering contract: the gate is acquired BEFORE an endpoint's
+# _resident_lock, never while holding it.
+_COLLECTIVE_GATE = threading.RLock()
+
 
 class Endpoint:
     """Base: bucket bookkeeping + the resident compiled-dispatch cache."""
 
     op: str = ""
+    # True on endpoints whose dispatch program contains cross-device
+    # collectives: their device launches serialize on _COLLECTIVE_GATE
+    collective_dispatch: bool = False
 
     def __init__(self, session: HarpSession, name: str,
                  bucket_sizes: Optional[Sequence[int]] = None):
@@ -201,7 +216,15 @@ class Endpoint:
         """Serve one coalesced batch; returns (one result per input row,
         the factor-epoch version that answered ALL of them)."""
         fn, args, n, _bucket, version = self.prepared_versioned(batch)
-        return self._unpack(fn(*args), n), version
+        if self.collective_dispatch:
+            # collective programs from different batcher threads must not
+            # overlap on the shared mesh (see _COLLECTIVE_GATE); the
+            # resident lock is NOT held here, so maintenance keeps moving
+            with _COLLECTIVE_GATE:
+                out = fn(*args)
+        else:
+            out = fn(*args)
+        return self._unpack(out, n), version
 
     def dispatch(self, batch) -> List:
         """Serve one coalesced batch; returns one result per input row."""
@@ -399,6 +422,7 @@ class TopKEndpoint(Endpoint):
     """
 
     op = "topk"
+    collective_dispatch = True      # bucket_route/route_back all_to_alls
 
     def __init__(self, session: HarpSession, name: str, user_factors,
                  item_factors, k: int = 10,
@@ -499,8 +523,10 @@ class TopKEndpoint(Endpoint):
         mine = np.flatnonzero(self._owner == int(rank))
         # the resident lock covers the whole move: dispatches pause for the
         # restore instead of racing a half-written shard or pairing the
-        # old program with the new state
-        with self._resident_lock:
+        # old program with the new state. The collective gate comes FIRST
+        # (the global ordering): the reshard rounds are collective programs
+        # and must not overlap an in-flight top-k dispatch on the mesh
+        with _COLLECTIVE_GATE, self._resident_lock:
             # only the factor payload and item table feed the move; keys/
             # counts are rebuilt host-side below (_keys_counts)
             vals_d, items = self._state[1], self._state[3]
@@ -656,8 +682,9 @@ class TopKEndpoint(Endpoint):
         slot, counts, cap = self._kv_layout(owner)
         # the resident lock covers the move AND the (state, fns) swap:
         # in-flight dispatches finish on the old pair, later ones see the
-        # owner-routed pair — never a mix
-        with self._resident_lock:
+        # owner-routed pair — never a mix. Collective gate first (global
+        # ordering): the reshard rounds must not overlap a live dispatch
+        with _COLLECTIVE_GATE, self._resident_lock:
             vals_d, items = self._state[1], self._state[3]
             # every row may shift slots, so the whole store reshards —
             # source is the LIVE device array (flat order owner*cap + slot)

@@ -369,6 +369,11 @@ class ProcessServeGang:
         env = {"HARP_PROCESS_ID": str(rank),
                "HARP_NUM_PROCESSES": str(self.world),
                "HARP_GANG_ATTEMPT": str(generation),
+               # the serving-gang world: parse_faults bounds request-clock
+               # rank=/peer= qualifiers against THIS, not the mesh width —
+               # a serving fault naming a rank outside the gang is a typo
+               # caught at parse time, not a silently dead spec
+               "HARP_SERVE_WORLD": str(self.world),
                "JAX_PLATFORMS": "cpu",
                **self.env_extra}
         # the launch module's member-spawn path: localhost Popen or ssh,
@@ -612,13 +617,27 @@ class LocalFleet:
     after a live refresh it is STALE, so the restore is skipped (and
     journaled) rather than silently overwriting fresh factors with old
     rows labeled as the new epoch. None skips the restore entirely: the
-    in-process mesh state survived the worker's threads."""
+    in-process mesh state survived the worker's threads.
+
+    Elasticity (ISSUE 16): :meth:`scale_up` mints a NEW worker rank and
+    re-homes chosen models onto it; :meth:`scale_down` drains one worker
+    and re-homes its models across the survivors. Both build the moved
+    endpoints FRESH from ``endpoint_builder(name, version)`` — the same
+    deterministic-spec discipline as the process fleet's spare path, so a
+    scaled-up worker warms from the AOT store (``aot_dir``) with
+    ``trace_counts`` still 0 — and both land through the same versioned
+    placement push chaos recovery exercises. The autoscaler
+    (:mod:`harp_tpu.serve.autoscaler`) drives these from load."""
 
     def __init__(self, workers: List, make_client: Callable, *,
                  canonical: Optional[Dict[str, np.ndarray]] = None,
                  telemetry_dir: Optional[str] = None,
                  journal_path: Optional[str] = None,
-                 poll_interval_s: float = 0.02, metrics=None):
+                 poll_interval_s: float = 0.02, metrics=None,
+                 endpoint_builder: Optional[Callable[[str, int],
+                                                     object]] = None,
+                 aot_dir: Optional[str] = None,
+                 aot_model_hashes: Optional[Dict[str, str]] = None):
         if metrics is None:
             from harp_tpu.utils.metrics import DEFAULT as metrics
         self.metrics = metrics
@@ -627,6 +646,13 @@ class LocalFleet:
         self.telemetry_dir = telemetry_dir
         self.journal = _Journal(journal_path)
         self.placement_version = 0
+        self.endpoint_builder = endpoint_builder
+        self.aot_dir = aot_dir
+        # spec hashes for the AOT store lookup: warm_artifacts exports
+        # under model_hash_from_spec, so a scaled-up worker must look up
+        # under the SAME axis or every load silently misses into a
+        # warm-compile (the structural fallback hash differs by design)
+        self.aot_model_hashes = dict(aot_model_hashes or {})
         self._make_client = make_client
         self._poll_interval_s = poll_interval_s
         self._lock = threading.Lock()
@@ -642,11 +668,23 @@ class LocalFleet:
         client = self._make_client(**kw)
         with self._lock:
             self._clients.append(client)
+            version = self.placement_version
+            placement = dict(self.placement)
+            peers = {w.rank: w.address for w in self._workers.values()
+                     if not w._closed}
+        if version:
+            # a client minted AFTER a scale/recovery event starts from the
+            # stale gang-construction map — hand it the live one directly
+            client.apply_placement(placement, peers, version)
         return client
 
     def workers(self) -> List:
         with self._lock:
             return list(self._workers.values())
+
+    def worker_count(self) -> int:
+        with self._lock:
+            return len(self._workers)
 
     def _journal(self, record: dict) -> None:
         # appended from the monitor thread and the caller's thread alike
@@ -734,6 +772,139 @@ class LocalFleet:
             "restored_rows": restored, "placement_version": version,
             "slo_incident_ranks": _fresh_incidents(self.telemetry_dir)})
         return replacement
+
+    # -- elasticity (ISSUE 16: the autoscaler's two moves) ------------------
+
+    def _push_local_placement(self) -> int:
+        """Bump the placement version and apply the current map + live
+        peer addresses to every worker and minted client directly (the
+        in-process analog of ProcessServeGang._push_placement)."""
+        with self._lock:
+            self.placement_version += 1
+            version = self.placement_version
+            gang = [w for w in self._workers.values() if not w._closed]
+            peers = {w.rank: w.address for w in gang}
+            placement = dict(self.placement)
+            clients = list(self._clients)
+        for w in gang:
+            w.apply_placement(placement, peers, version)
+        for c in clients:
+            c.apply_placement(placement, peers, version)
+        return version
+
+    def _require_builder(self, what: str):
+        if self.endpoint_builder is None:
+            raise RuntimeError(
+                f"{what} needs an endpoint_builder(name, version) — the "
+                f"deterministic-spec path that re-materializes a model on "
+                f"a new rank (fleet.build_endpoint wraps one)")
+        return self.endpoint_builder
+
+    def scale_up(self, models: List[str]) -> object:
+        """Grow the fleet by one worker and re-home ``models`` onto it.
+
+        The new endpoints are built FRESH from ``endpoint_builder(name,
+        version)`` at each model's current factor epoch (the spare-pool
+        discipline: zero-build + reshard-engine restore, AOT artifacts
+        from ``aot_dir`` so nothing recompiles), the re-pointed placement
+        is pushed to the whole gang, and only THEN do the donors drain
+        the moved models — a request routed off the old map mid-move is
+        forwarded by its donor to the new owner; nothing is refused.
+        Returns the new :class:`~harp_tpu.serve.router.ServeWorker`."""
+        from harp_tpu.serve.router import ServeWorker
+
+        builder = self._require_builder("scale_up")
+        models = [str(m) for m in models]
+        with self._lock:
+            gang = [w for w in self._workers.values() if not w._closed]
+            if not gang:
+                raise RuntimeError("no live workers to scale from")
+            template = min(gang, key=lambda w: w.rank)
+            donors = {}
+            for m in models:
+                if m not in self.placement:
+                    raise ValueError(f"unknown model {m!r}")
+                donors[m] = self._workers.get(self.placement[m])
+            # a fresh rank that collides with NO worker and no minted
+            # client (the reply-rank-collision guard would drop that
+            # client's replies otherwise)
+            taken = set(self._workers) | {c.rank for c in self._clients}
+            new_rank = max(self._workers) + 1
+            while new_rank in taken:
+                new_rank += 1
+            peers = {w.rank: w.address for w in gang}
+        endpoints = {}
+        for m in models:
+            donor_ep = (donors[m].endpoints.get(m)
+                        if donors[m] is not None else None)
+            version = int(getattr(donor_ep, "version", 0) or 0)
+            endpoints[m] = builder(m, version)
+        worker = ServeWorker(
+            template.session, new_rank, endpoints, self.placement,
+            peers=peers, secret=template._secret,
+            max_wait_s=template.max_wait_s, metrics=template.metrics,
+            cache=template.cache, aot_store=self.aot_dir,
+            aot_model_hashes=self.aot_model_hashes or None,
+            max_queue=template.max_queue,
+            brownout_min_priority=template.brownout_min_priority)
+        with self._lock:
+            self._workers[new_rank] = worker
+            for m in models:
+                self.placement[m] = new_rank
+        version = self._push_local_placement()
+        for m in models:
+            donor = donors[m]
+            if donor is not None and donor is not worker:
+                # drain AFTER the re-pointing landed: accepted requests
+                # answer from the old endpoint, later arrivals forward
+                donor.remove_endpoint(m)
+        self.metrics.count("fleet.scale_ups")
+        self.metrics.gauge("fleet.workers", self.worker_count())
+        self._journal({
+            "event": "scale-up", "rank": new_rank, "models": models,
+            "placement_version": version,
+            "trace_counts": {m: sum(ep.trace_counts.values())
+                             for m, ep in endpoints.items()
+                             if hasattr(ep, "trace_counts")},
+            "aot_loaded": {m: len(b) for m, b in worker.aot_loaded.items()},
+            "slo_incident_ranks": _fresh_incidents(self.telemetry_dir)})
+        return worker
+
+    def scale_down(self, rank: int, timeout: float = 30.0) -> Dict[str, int]:
+        """Shrink the fleet by one worker: its models are re-built on the
+        least-loaded survivors (same deterministic-builder path as
+        scale_up), the re-pointed placement is pushed, and the victim
+        drains cleanly — accepted requests are answered, nothing is
+        dropped. Returns ``{model: new owner rank}``."""
+        builder = self._require_builder("scale_down")
+        rank = int(rank)
+        with self._lock:
+            victim = self._workers.get(rank)
+            survivors = [w for w in self._workers.values()
+                         if w is not victim and not w._closed]
+            if victim is None:
+                raise ValueError(f"no worker at rank {rank}")
+            if not survivors:
+                raise RuntimeError("refusing to scale down the last worker")
+        moved: Dict[str, int] = {}
+        for m, ep in sorted(victim.endpoints.items()):
+            target = min(survivors, key=lambda w: (len(w.endpoints),
+                                                   w.rank))
+            version = int(getattr(ep, "version", 0) or 0)
+            target.add_endpoint(m, builder(m, version))
+            moved[m] = target.rank
+        with self._lock:
+            self.placement.update(moved)
+            del self._workers[rank]
+        version = self._push_local_placement()
+        victim.close(timeout)
+        self.metrics.count("fleet.scale_downs")
+        self.metrics.gauge("fleet.workers", self.worker_count())
+        self._journal({
+            "event": "scale-down", "rank": rank, "moved": moved,
+            "placement_version": version,
+            "slo_incident_ranks": _fresh_incidents(self.telemetry_dir)})
+        return moved
 
     def close(self, close_workers: bool = True) -> None:
         self._stopping.set()

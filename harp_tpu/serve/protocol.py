@@ -84,6 +84,11 @@ ERR_FORWARD = "forward-failed"
 # transport guarantees the reply can never come, so the future is failed
 # NOW instead of hanging to its timeout; retryable by request_retry
 ERR_DEAD_RANK = "dead-rank"
+# admission control (ISSUE 16): the owner's queue is over its bound (or
+# brownout is shedding this priority tier) — RETRYABLE, and the reply
+# carries a computed ``retry_after_s`` (queue depth x observed dispatch
+# rate) that request_retry honors before resubmitting
+ERR_OVERLOADED = "overloaded"
 
 
 class ServeError(RuntimeError):
@@ -93,23 +98,37 @@ class ServeError(RuntimeError):
 
 def make_request(req_id: str, op: str, model: str, data: Any,
                  reply_to: Tuple[int, str, int],
-                 deadline_ts: Optional[float] = None) -> dict:
+                 deadline_ts: Optional[float] = None,
+                 priority: int = 0) -> dict:
+    """``priority`` (ISSUE 16): the load-shedding tier — anything >= the
+    worker's ``brownout_min_priority`` keeps being served while a burning
+    SLO watchdog sheds the rest. The worker default (0) sheds nothing at
+    default priority: brownout is opt-in, by raising the threshold or by
+    submitting declared-droppable (negative-priority) traffic."""
     if op not in (OP_TOPK, OP_CLASSIFY):
         raise ValueError(f"op must be {OP_TOPK!r} or {OP_CLASSIFY!r}, "
                          f"got {op!r}")
     return {"kind": REQUEST, "id": req_id, "op": op, "model": model,
             "data": data, "reply_to": tuple(reply_to),
-            "ts": time.time(), "deadline_ts": deadline_ts}
+            "ts": time.time(), "deadline_ts": deadline_ts,
+            "priority": int(priority)}
 
 
 def make_reply(request: dict, ok: bool, result: Any = None,
                error: Optional[str] = None, served_by: Optional[int] = None,
                batch: Optional[int] = None,
                bucket: Optional[int] = None,
-               version: Optional[int] = None) -> dict:
-    return {"kind": REPLY, "id": request["id"], "ok": bool(ok),
-            "result": result, "error": error, "served_by": served_by,
-            "batch": batch, "bucket": bucket, "version": version}
+               version: Optional[int] = None,
+               retry_after_s: Optional[float] = None) -> dict:
+    """``retry_after_s`` rides only on ``overloaded`` sheds: the worker's
+    estimate of when the queue it refused admission to will have drained
+    (depth x observed per-request service time)."""
+    reply = {"kind": REPLY, "id": request["id"], "ok": bool(ok),
+             "result": result, "error": error, "served_by": served_by,
+             "batch": batch, "bucket": bucket, "version": version}
+    if retry_after_s is not None:
+        reply["retry_after_s"] = float(retry_after_s)
+    return reply
 
 
 def make_placement(placement: dict, peers: dict, version: int) -> dict:

@@ -101,7 +101,9 @@ class ServeWorker:
                  aot_store=None,
                  aot_model_hashes: Optional[Dict[str, str]] = None,
                  compile_cache_dir: Optional[str] = None,
-                 on_control: Optional[Callable[[dict], None]] = None):
+                 on_control: Optional[Callable[[dict], None]] = None,
+                 max_queue: Optional[int] = None,
+                 brownout_min_priority: int = 0):
         if metrics is None:
             from harp_tpu.utils.metrics import DEFAULT as metrics
         self.session = session
@@ -178,10 +180,16 @@ class ServeWorker:
         overrides = max_wait_overrides or {}
         self.max_wait_overrides = {str(m): float(v)
                                    for m, v in overrides.items()}
+        # admission control (ISSUE 16): max_queue bounds every batcher's
+        # backlog (over-bound submits are shed with a retryable overloaded
+        # reply); brownout rides the SLO watchdog's burning state — while
+        # the error budget burns, sub-brownout_min_priority traffic is
+        # shed even from a within-bounds queue. Hot-key cache hits are
+        # served in _handle BEFORE admission, so they survive brownout.
+        self.max_queue = max_queue
+        self.brownout_min_priority = brownout_min_priority
         self.batchers: Dict[str, MicroBatcher] = {
-            name: MicroBatcher(ep, self._make_reply_fn(), metrics=metrics,
-                               max_wait_s=self.max_wait_overrides.get(
-                                   name, max_wait_s))
+            name: self._make_batcher(name, ep)
             for name, ep in self.endpoints.items()}
         # drain flag crosses threads (begin_drain on the caller's thread,
         # checked in the receive loop): an Event, not a bare bool — the
@@ -387,11 +395,65 @@ class ServeWorker:
         except (OSError, TypeError):
             self.metrics.count("serve.lost_replies")
 
+    # -- elastic endpoint set (ISSUE 16 autoscaler moves) -------------------
+
+    def _brownout(self) -> bool:
+        """The batchers' brownout arm: True while the SLO watchdog reports
+        its error budget burning (no watchdog = never brown out)."""
+        slo = self.slo
+        if slo is None:
+            return False
+        is_burning = getattr(slo, "is_burning", None)
+        return bool(is_burning()) if is_burning is not None \
+            else bool(getattr(slo, "burning", False))
+
+    def _make_batcher(self, name: str, ep) -> MicroBatcher:
+        return MicroBatcher(
+            ep, self._make_reply_fn(), metrics=self.metrics,
+            max_wait_s=self.max_wait_overrides.get(name, self.max_wait_s),
+            max_queue=self.max_queue, brownout_fn=self._brownout,
+            brownout_min_priority=self.brownout_min_priority)
+
+    def add_endpoint(self, name: str, ep) -> None:
+        """Install a model endpoint LIVE (the autoscaler's scale-up /
+        scale-down move target): a fresh batcher starts serving it the
+        moment this returns. The fleet pushes the re-pointed placement
+        separately — until then requests for ``name`` still route to the
+        old owner and get forwarded here once the map lands."""
+        name = str(name)
+        # the model maps are read by the receive loop while the fleet
+        # installs from its own thread — mutate under the same lock the
+        # placement state rides
+        with self._placement_lock:
+            if name in self.batchers:
+                raise ValueError(f"endpoint {name!r} already installed on "
+                                 f"rank {self.rank}")
+            self.endpoints[name] = ep
+            self.batchers[name] = self._make_batcher(name, ep)
+        self.metrics.count("serve.endpoints_added")
+
+    def remove_endpoint(self, name: str, timeout: float = 30.0):
+        """Drain and uninstall one model endpoint (the donor side of a
+        scale move). Call AFTER the placement re-pointing the model away
+        from this rank has been pushed — accepted requests drain through
+        the batcher, later arrivals forward to the new owner off the
+        updated map. Returns the endpoint object (the fleet re-homes it)
+        or None when this rank never served it."""
+        name = str(name)
+        # unhook under the placement lock; the (blocking) drain runs after
+        with self._placement_lock:
+            batcher = self.batchers.pop(name, None)
+            ep = self.endpoints.pop(name, None)
+        if batcher is not None:
+            batcher.drain_and_stop(timeout)
+            self.metrics.count("serve.endpoints_removed")
+        return ep
+
     # -- reply path ---------------------------------------------------------
 
     def _make_reply_fn(self) -> Callable:
         def reply(msg, ok, result=None, error=None, batch=None, bucket=None,
-                  version=None):
+                  version=None, retry_after_s=None):
             if (ok and self.cache is not None
                     and msg.get("op") == protocol.OP_TOPK):
                 # fill AT the reply boundary: the result was computed under
@@ -399,11 +461,13 @@ class ServeWorker:
                 self.cache.put(msg.get("model"), msg.get("data"), version,
                                result)
             self._reply(msg, ok=ok, result=result, error=error, batch=batch,
-                        bucket=bucket, version=version)
+                        bucket=bucket, version=version,
+                        retry_after_s=retry_after_s)
         return reply
 
     def _reply(self, msg: dict, ok: bool, result=None, error=None,
-               batch=None, bucket=None, version=None) -> None:
+               batch=None, bucket=None, version=None,
+               retry_after_s=None) -> None:
         if self.slo is not None:
             # one (age, ok) sample per reply: age = now − the client's
             # submit wall, i.e. end-to-end minus the reply hop — the
@@ -433,7 +497,7 @@ class ServeWorker:
         reply = protocol.make_reply(
             msg, ok=ok, result=result, error=error,
             served_by=self.rank, batch=batch, bucket=bucket,
-            version=version)
+            version=version, retry_after_s=retry_after_s)
         tr = msg.get(spans.TRACE_KEY)
         if tr is not None:
             # the accumulated trace rides the reply home: the CLIENT holds
@@ -553,7 +617,11 @@ class _PendingReply:
                 self._discard()
             raise TimeoutError("no reply within timeout")
         if not self.reply["ok"]:
-            raise protocol.ServeError(self.reply.get("error") or "unknown")
+            err = protocol.ServeError(self.reply.get("error") or "unknown")
+            # the raw reply rides on the exception: the retry layer reads
+            # retry_after_s off a shed reply without re-parsing the string
+            err.reply = self.reply
+            raise err
         return self.reply["result"]
 
 
@@ -564,7 +632,8 @@ class RouterClient:
                  placement: Dict[str, int], *,
                  secret: Optional[bytes] = None, host: str = "127.0.0.1",
                  metrics=None, trace_sample: Optional[int] = None,
-                 span_metrics=None):
+                 span_metrics=None, breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 1.0):
         if metrics is None:
             from harp_tpu.utils.metrics import DEFAULT as metrics
         self.rank = rank
@@ -598,6 +667,17 @@ class RouterClient:
         self._dead_ranks: set = set()
         self._placement_seen = 0
         self._placement_cv = threading.Condition(self._lock)
+        # per-rank circuit breaker (ISSUE 16): K consecutive transport
+        # failures OPEN the circuit — submits to that rank fail fast
+        # without dialing — until breaker_cooldown_s elapses, then ONE
+        # half-open probe is let through; its success closes the circuit,
+        # its failure re-opens (and re-arms the cooldown). State lives in
+        # {rank: {"fails", "state", "opened_at"}} under _lock; a placement
+        # frame re-announcing a rank resets its breaker (the supervisor
+        # vouches for the address).
+        self.breaker_threshold = max(1, int(breaker_threshold))
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self._breaker: Dict[int, dict] = {}
         self._ids = itertools.count()
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -638,6 +718,14 @@ class RouterClient:
                 entry = self._waiting.pop(payload.get("id"), None)
             if entry is not None:
                 entry[1]._set(payload)
+            else:
+                # a reply whose id is not waiting: late (its future timed
+                # out and was discarded) or a netdup'd duplicate (the
+                # first copy already popped the slot). Dropping is CORRECT
+                # — ids are minted from an ever-increasing counter, never
+                # reused, so an orphan can never be delivered into a later
+                # request's future — but it must be visible, not silent
+                self.metrics.count("serve.client.orphan_replies")
             if tr is not None:
                 self._finish_span(tr)
 
@@ -697,6 +785,10 @@ class RouterClient:
             # recovery bumps the version; if the rank really is dead the
             # next submit re-marks it in ~one failed connect)
             self._dead_ranks -= set(peers)
+            # same vouching resets the circuit breaker: the supervisor
+            # re-announcing an address means it believes the rank dials
+            for r in peers:
+                self._breaker.pop(r, None)
             self._placement_cv.notify_all()
         if applied:
             self.metrics.count("serve.placement_updates")
@@ -732,6 +824,54 @@ class RouterClient:
         if victims:
             self.metrics.count("serve.client_inflight_failed_fast",
                                len(victims))
+
+    # -- circuit breaker (ISSUE 16) -----------------------------------------
+
+    def breaker_state(self, rank: int) -> str:
+        """``"closed"`` | ``"open"`` | ``"half-open"`` for tests/ops."""
+        with self._lock:
+            st = self._breaker.get(int(rank))
+            return st["state"] if st is not None else "closed"
+
+    def _breaker_admit(self, rank: int) -> None:
+        """Gate one submit through rank's breaker: raises ConnectionError
+        (fail fast, nothing dialed) while the circuit is open; after the
+        cooldown the FIRST caller becomes the half-open probe and exactly
+        one request goes through until its outcome lands."""
+        with self._lock:
+            st = self._breaker.get(rank)
+            if st is None or st["state"] == "closed":
+                return
+            if st["state"] == "open" and (time.monotonic() - st["opened_at"]
+                                          >= self.breaker_cooldown_s):
+                st["state"] = "half-open"   # this caller is the probe
+                return
+        self.metrics.count("serve.client.breaker_fastfail")
+        raise ConnectionError(
+            f"circuit open for rank {rank} "
+            f"({self.breaker_threshold} consecutive transport failures; "
+            f"probe in {self.breaker_cooldown_s}s)")
+
+    def _breaker_success(self, rank: int) -> None:
+        with self._lock:
+            st = self._breaker.pop(rank, None)
+            was_open = st is not None and st["state"] != "closed"
+        if was_open:
+            self.metrics.count("serve.client.breaker_closed")
+
+    def _breaker_failure(self, rank: int) -> None:
+        with self._lock:
+            st = self._breaker.setdefault(
+                rank, {"fails": 0, "state": "closed", "opened_at": 0.0})
+            st["fails"] += 1
+            opening = (st["state"] == "half-open"       # failed probe
+                       or (st["state"] == "closed"
+                           and st["fails"] >= self.breaker_threshold))
+            if opening:
+                st["state"] = "open"
+                st["opened_at"] = time.monotonic()
+        if opening:
+            self.metrics.count("serve.client.breaker_open")
 
     def sync_placement(self, timeout: float = 5.0) -> bool:
         """Pull the current placement from the surviving workers: send
@@ -773,7 +913,8 @@ class RouterClient:
                       backoff_s: float = 0.05,
                       backoff_factor: float = 2.0,
                       backoff_max_s: float = 2.0, jitter: float = 0.5,
-                      sync_timeout: float = 5.0,
+                      sync_timeout: float = 5.0, priority: int = 0,
+                      retry_after_cap_s: float = 5.0,
                       sleep: Callable[[float], None] = time.sleep):
         """Synchronous point query with the fleet's retry contract
         (ISSUE 14): bounded ``attempts``, exponential backoff with
@@ -789,6 +930,12 @@ class RouterClient:
           (the waiting map stays bounded), placement re-synced, retried;
         * a clean ``shutting-down`` reply (worker draining mid-swap) →
           re-synced and retried;
+        * an ``overloaded`` shed (ISSUE 16) → retried WITHOUT a placement
+          re-sync (the map did not change — the queue is just full), and
+          the backoff honors the reply's ``retry_after_s`` (the server's
+          own drain estimate, capped at ``retry_after_cap_s`` so a
+          corrupt frame cannot stall the client) when it exceeds the
+          exponential schedule;
         * any other server-reported error (unknown model, dispatch error,
           deadline) is PERMANENT for this request and raises immediately —
           retrying a malformed query cannot help.
@@ -797,6 +944,7 @@ class RouterClient:
         import random
 
         last: Optional[Exception] = None
+        retry_after: Optional[float] = None
         attempts = max(1, attempts)
         for attempt in range(attempts):
             def resync():
@@ -808,6 +956,9 @@ class RouterClient:
                 delay = min(backoff_s * backoff_factor ** (attempt - 1),
                             backoff_max_s)
                 delay *= 1.0 + jitter * random.random()
+                if retry_after is not None:
+                    delay = max(delay, retry_after)
+                    retry_after = None
                 self.metrics.count("serve.client_retries")
                 sleep(delay)
             with self._lock:
@@ -820,7 +971,8 @@ class RouterClient:
                 resync()
                 continue
             try:
-                pending = self.submit(op, model, data, dest=dest)
+                pending = self.submit(op, model, data, dest=dest,
+                                      priority=priority)
             except ConnectionError as e:
                 # the send itself failed — the fast-fail leg: nobody
                 # waited a reply timeout to learn the rank is gone
@@ -845,10 +997,19 @@ class RouterClient:
             except protocol.ServeError as e:
                 # shutting-down (draining mid-swap), dead-rank (an
                 # in-flight future failed fast by a placement update),
-                # and forward-failed (a worker's stale map hit the dead
-                # owner) are the transient server states — everything
-                # else is permanent for this request
+                # forward-failed (a worker's stale map hit the dead
+                # owner), and overloaded (admission shed) are the
+                # transient server states — everything else is permanent
+                # for this request
                 msg = str(e)
+                if msg.startswith(protocol.ERR_OVERLOADED):
+                    last = e
+                    self.metrics.count("serve.client_overloaded")
+                    ra = (getattr(e, "reply", None) or {}).get(
+                        "retry_after_s")
+                    if isinstance(ra, (int, float)) and ra > 0:
+                        retry_after = min(float(ra), retry_after_cap_s)
+                    continue         # no resync: the map didn't change
                 if protocol.ERR_SHUTTING_DOWN not in msg \
                         and not msg.startswith(protocol.ERR_DEAD_RANK) \
                         and not msg.startswith(protocol.ERR_FORWARD):
@@ -863,11 +1024,14 @@ class RouterClient:
 
     def submit(self, op: str, model: str, data, *,
                deadline_ts: Optional[float] = None,
-               dest: Optional[int] = None) -> _PendingReply:
+               dest: Optional[int] = None,
+               priority: int = 0) -> _PendingReply:
         """Asynchronously submit one point query; returns the reply future.
         ``dest`` overrides the placement-derived owner (tests exercise the
-        forwarding leg this way). A ``dest`` marked dead fails fast with
-        ConnectionError — no socket timeout, no reply wait."""
+        forwarding leg this way). A ``dest`` marked dead or behind an open
+        circuit breaker fails fast with ConnectionError — no socket
+        timeout, no reply wait. ``priority`` >= the worker's brownout
+        floor survives load shedding while the SLO budget burns."""
         if self._closed:
             raise ConnectionError("client is closed")
         n = next(self._ids)
@@ -883,10 +1047,11 @@ class RouterClient:
             self.metrics.count("serve.client_fastfail")
             raise ConnectionError(f"rank {dest} is marked dead — awaiting "
                                   f"a placement update that revives it")
+        self._breaker_admit(dest)
         msg = protocol.make_request(
             rid, op, model, data,
             reply_to=(self.rank,) + tuple(self.transport.address),
-            deadline_ts=deadline_ts)
+            deadline_ts=deadline_ts, priority=priority)
         if self.trace_sample and n % self.trace_sample == 0:
             spans.start_trace(msg, op=op, model=model)
 
@@ -899,16 +1064,23 @@ class RouterClient:
             self._waiting[rid] = (dest, pending)
         try:
             self.transport.send(dest, msg)
-        except (KeyError, ConnectionError):
+        except ConnectionError:
+            with self._lock:
+                self._waiting.pop(rid, None)
+            self._breaker_failure(dest)
+            raise
+        except KeyError:
             with self._lock:
                 self._waiting.pop(rid, None)
             raise
+        self._breaker_success(dest)
         return pending
 
     def request(self, op: str, model: str, data, *, timeout: float = 30.0,
-                dest: Optional[int] = None):
+                dest: Optional[int] = None, priority: int = 0):
         """Synchronous point query (submit + wait)."""
-        return self.submit(op, model, data, dest=dest).result(timeout)
+        return self.submit(op, model, data, dest=dest,
+                           priority=priority).result(timeout)
 
     def close(self) -> None:
         with self._close_lock:
@@ -937,7 +1109,10 @@ def local_gang(session, worker_endpoints: List[Dict[str, object]], *,
                metrics_port: Optional[int] = None,
                trace_sample: Optional[int] = None,
                cache=None, aot_dir: Optional[str] = None,
-               compile_cache_dir: Optional[str] = None
+               compile_cache_dir: Optional[str] = None,
+               max_queue: Optional[int] = None,
+               brownout_min_priority: int = 0,
+               client_rank_base: Optional[int] = None
                ) -> Tuple[List[ServeWorker], Callable[..., RouterClient]]:
     """An in-process serving gang on loopback (the tier-1/bench topology;
     multi-host gangs pass explicit peer maps or KV rendezvous instead).
@@ -957,6 +1132,14 @@ def local_gang(session, worker_endpoints: List[Dict[str, object]], *,
     shared hot-key reply cache (serve/cache.py) across the gang's workers
     — the in-process fleet's "replicate the hot keys at every router"
     configuration.
+
+    Overload plane (ISSUE 16): ``max_queue``/``brownout_min_priority``
+    forward to every worker's admission control. ``client_rank_base``
+    sets where minted client ranks start — the default (gang size) is
+    fine for a FIXED gang, but a fleet that scales UP mints new worker
+    ranks past the gang too; pass a high base (e.g. the process fleet's
+    1000) so a scaled-up worker's rank can never collide with a client's
+    and trip the reply-rank-collision guard.
     """
     from harp_tpu.telemetry.watchdog import SLOWatchdog
 
@@ -968,6 +1151,8 @@ def local_gang(session, worker_endpoints: List[Dict[str, object]], *,
                            aot_store=aot_dir,
                            compile_cache_dir=compile_cache_dir,
                            metrics=metrics, cache=cache,
+                           max_queue=max_queue,
+                           brownout_min_priority=brownout_min_priority,
                            slo=(SLOWatchdog(slo_p99_s, rank=r,
                                             metrics=metrics,
                                             **(slo_kw or {}))
@@ -980,7 +1165,8 @@ def local_gang(session, worker_endpoints: List[Dict[str, object]], *,
         for v in workers:
             if v.rank != w.rank:
                 w.transport.add_peer(v.rank, v.address)
-    next_rank = itertools.count(len(workers))
+    next_rank = itertools.count(len(workers) if client_rank_base is None
+                                else int(client_rank_base))
 
     def make_client(metrics_override=None,
                     span_metrics=None) -> RouterClient:

@@ -138,6 +138,14 @@ class SLOWatchdog:
             self._window.append((now, float(latency_s), bool(ok)))
             self._evaluate_locked(now=now)
 
+    def is_burning(self) -> bool:
+        """Thread-safe read of the live burn state — the serving brownout
+        arm (ISSUE 16): every MicroBatcher admission decision polls this,
+        so it takes the lock rather than racing the bare attribute the
+        evaluator writes under it."""
+        with self._lock:
+            return self.burning
+
     # -- evaluation ---------------------------------------------------------
 
     def _prune(self, now: float) -> None:

@@ -1,0 +1,158 @@
+"""Wire fault grammar tests (ISSUE 16): parse-time loudness for the net
+kinds plus REAL-socket injection at the p2p frame boundary — the frames
+cross an actual loopback connection and the receiver's decode guard, not
+a mocked transport.
+"""
+
+import pytest
+
+from harp_tpu.parallel import faults
+from harp_tpu.parallel.events import EventQueue
+from harp_tpu.parallel.p2p import P2PTransport
+
+
+def _pair():
+    q0, q1 = EventQueue(), EventQueue()
+    t0 = P2PTransport(q0, rank=0, peers={})
+    t1 = P2PTransport(q1, rank=1, peers={0: t0.address})
+    t0._peers[1] = t1.address
+    return q0, q1, t0, t1
+
+
+# --------------------------------------------------------------------------- #
+# Grammar: the wire kinds parse, and meaningless qualifiers fail LOUDLY
+# --------------------------------------------------------------------------- #
+
+def test_net_grammar_parses_every_wire_kind():
+    (drop,) = faults.parse_faults("netdrop@request=3")
+    assert (drop.kind, drop.request, drop.rank) == ("netdrop", 3, None)
+    (delay,) = faults.parse_faults("netdelay@request=1:ms=5:rank=2")
+    assert (delay.kind, delay.ms, delay.rank) == ("netdelay", 5, 2)
+    (part,) = faults.parse_faults("netpart@request=1:rank=0:peer=1")
+    assert (part.kind, part.peer) == ("netpart", 1)
+    specs = faults.parse_faults("netdup@request=2,netcorrupt@request=4")
+    assert [s.kind for s in specs] == ["netdup", "netcorrupt"]
+
+
+def test_net_grammar_rejects_meaningless_qualifiers():
+    for bad in (
+        "netdrop@epoch=3",               # wire kinds ride the frame clock
+        "netcorrupt@request=1:ms=5",     # ms= is slow/netdelay only
+        "netpart@request=1",             # a directed cut NEEDS peer=
+        "kill@request=1:peer=0",         # peer= is netpart only
+        "netdrop@request=0",             # frame clock is 1-based
+        "netdelay@epoch=2:ms=5",         # even the sustained kinds
+        "netdup@request=1:epoch=1",      # never both clocks
+    ):
+        with pytest.raises(ValueError):
+            faults.parse_faults(bad)
+
+
+def test_net_grammar_rank_bounds_use_the_serving_world(monkeypatch):
+    # request-clock specs live in the SERVING gang's rank space: a rank
+    # the serving world cannot hold is a scripting bug, rejected at parse
+    with pytest.raises(ValueError, match="serving world"):
+        faults.parse_faults("netdrop@request=1:rank=5", serve_world_size=2)
+    with pytest.raises(ValueError, match="serving world"):
+        faults.parse_faults("netpart@request=1:peer=3", serve_world_size=2)
+    assert faults.parse_faults("netdrop@request=1:rank=1",
+                               serve_world_size=2)
+    # the fleet spawner exports the width; parse reads it from the env
+    monkeypatch.setenv("HARP_SERVE_WORLD", "2")
+    with pytest.raises(ValueError, match="serving world"):
+        faults.parse_faults("netdup@request=1:rank=3")
+    # epoch-clock specs still bound against the TRAINING world
+    assert faults.parse_faults("crash@epoch=1:rank=5", world_size=8,
+                               serve_world_size=2)
+    # a spec disarmed by attempt gating is exempt (post-shrink relaunch
+    # keeps the env that killed the old top rank)
+    assert faults.parse_faults("netdrop@request=1:rank=9:attempt=1",
+                               serve_world_size=2)
+
+
+def test_net_fire_one_shot_per_rank_and_delay_sustained(monkeypatch):
+    monkeypatch.setenv("HARP_FAULT", "netdrop@request=5")
+    assert faults.net_fire(4, rank=0, dest=1) == []
+    assert faults.net_fire(5, rank=0, dest=1) == ["drop"]
+    assert faults.net_fire(6, rank=0, dest=1) == []     # once per (spec,
+    assert faults.net_fire(9, rank=1, dest=0) == ["drop"]   # rank)
+    monkeypatch.setenv("HARP_FAULT", "netdelay@request=2:ms=9")
+    naps = []
+    for n in (1, 2, 3):
+        assert faults.net_fire(n, rank=0, dest=1, sleep=naps.append) == []
+    assert naps == [0.009, 0.009]        # sustained from frame 2 on
+
+
+# --------------------------------------------------------------------------- #
+# Real sockets: the transport applies the actions at its frame boundary
+# --------------------------------------------------------------------------- #
+
+def test_netdrop_eats_exactly_one_frame(monkeypatch):
+    q0, q1, t0, t1 = _pair()
+    monkeypatch.setenv("HARP_FAULT", "netdrop@request=2:rank=0")
+    try:
+        for i in range(3):
+            t0.send(1, {"i": i})
+        # frame 2 vanished on the wire; the sender saw a clean send and
+        # the connection carried frame 3 as if nothing happened
+        got = [q1.wait(timeout=30.0).payload["i"] for _ in range(2)]
+        assert got == [0, 2]
+        assert len(q1) == 0
+    finally:
+        monkeypatch.delenv("HARP_FAULT")
+        t0.close()
+        t1.close()
+
+
+def test_netdup_delivers_the_frame_twice(monkeypatch):
+    q0, q1, t0, t1 = _pair()
+    monkeypatch.setenv("HARP_FAULT", "netdup@request=1:rank=0")
+    try:
+        t0.send(1, "hello")
+        assert q1.wait(timeout=30.0).payload == "hello"
+        assert q1.wait(timeout=30.0).payload == "hello"   # the retransmit
+        t0.send(1, "after")                               # one-shot: clean
+        assert q1.wait(timeout=30.0).payload == "after"
+        assert len(q1) == 0
+    finally:
+        monkeypatch.delenv("HARP_FAULT")
+        t0.close()
+        t1.close()
+
+
+def test_netcorrupt_dropped_by_decode_guard_connection_survives(monkeypatch):
+    q0, q1, t0, t1 = _pair()
+    monkeypatch.setenv("HARP_FAULT", "netcorrupt@request=1:rank=0")
+    try:
+        t0.send(1, "garbled-on-the-wire")
+        # the length prefix stayed true, so the receiver consumed exactly
+        # one frame of garbage, dropped it, and kept the connection: the
+        # NEXT frame arrives on the same socket
+        t0.send(1, "clean")
+        ev = q1.wait(timeout=30.0)
+        assert ev is not None and ev.payload == "clean"
+        assert len(q1) == 0
+    finally:
+        monkeypatch.delenv("HARP_FAULT")
+        t0.close()
+        t1.close()
+
+
+def test_netpart_is_directed_and_sustained(monkeypatch):
+    q0, q1, t0, t1 = _pair()
+    monkeypatch.setenv("HARP_FAULT", "netpart@request=1:rank=0:peer=1")
+    try:
+        # rank 0 cannot reach 1 — the same ConnectionError a dead NIC
+        # produces, raised before the socket is touched, every time
+        for _ in range(2):
+            with pytest.raises(ConnectionError):
+                t0.send(1, "cut")
+        # ...but the cut is DIRECTED: 1 -> 0 still flows
+        t1.send(0, "reverse-ok")
+        ev = q0.wait(timeout=30.0)
+        assert ev is not None and ev.payload == "reverse-ok"
+        assert len(q1) == 0
+    finally:
+        monkeypatch.delenv("HARP_FAULT")
+        t0.close()
+        t1.close()

@@ -1,0 +1,378 @@
+"""Overload-resilience tests (ISSUE 16): admission control + load
+shedding at the batcher, the deadline-vs-shed exactly-one-reply contract,
+duplicate-reply idempotence at the client, the per-rank circuit breaker,
+and ``request_retry`` honoring a shed reply's ``retry_after_s``.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from harp_tpu.parallel.events import Event, EventType
+from harp_tpu.serve import OP_TOPK, MicroBatcher, TopKReplyCache, protocol
+from harp_tpu.serve.router import RouterClient, _PendingReply, local_gang
+from harp_tpu.utils.metrics import Metrics
+
+
+class _FakeEndpoint:
+    name = "fake"
+    op = "classify"
+    bucket_sizes = (4, 8)
+    max_batch = 8
+
+    def __init__(self):
+        self.batches = []
+
+    def bucket_for(self, n):
+        for b in self.bucket_sizes:
+            if n <= b:
+                return b
+        raise ValueError(n)
+
+    def validate_query(self, op, data):
+        return None if op == self.op else f"op {op!r} mismatch"
+
+    def dispatch(self, batch):
+        self.batches.append(len(batch))
+        return list(range(len(batch)))
+
+
+def _collecting_reply():
+    replies = []
+    lock = threading.Lock()
+
+    def reply(msg, ok, result=None, error=None, batch=None, bucket=None,
+              **kw):
+        with lock:
+            replies.append({"id": msg["id"], "ok": ok, "result": result,
+                            "error": error, "batch": batch,
+                            "bucket": bucket, **kw})
+    return replies, reply
+
+
+def _msg(i, deadline_ts=None, priority=0):
+    return {"kind": protocol.REQUEST, "id": f"t-{i}", "op": "classify",
+            "model": "fake", "data": float(i),
+            "reply_to": (9, "127.0.0.1", 1), "ts": time.time(),
+            "deadline_ts": deadline_ts, "priority": priority}
+
+
+# --------------------------------------------------------------------------- #
+# Admission control: bounded queue, retryable shed, brownout priorities
+# --------------------------------------------------------------------------- #
+
+def test_queue_bound_sheds_with_retryable_reply_and_retry_after():
+    ep = _FakeEndpoint()
+    replies, reply = _collecting_reply()
+    m = Metrics()
+    # window >> test budget: nothing dispatches, the queue only grows
+    b = MicroBatcher(ep, reply, max_wait_s=10.0, max_queue=2, metrics=m)
+    try:
+        assert b.submit(_msg(0))
+        assert b.submit(_msg(1))
+        assert b.submit(_msg(2))          # True: HANDLED (shed reply sent)
+        shed = [r for r in replies if not r["ok"]]
+        assert len(shed) == 1 and shed[0]["id"] == "t-2"
+        assert shed[0]["error"].startswith(protocol.ERR_OVERLOADED)
+        # the reply tells the client how long the backlog needs: with no
+        # dispatch observed yet the EWMA falls back to max_wait_s —
+        # ceil(2/8) windows x 10 s + one coalescing window = 20 s
+        assert shed[0]["retry_after_s"] == pytest.approx(20.0)
+        assert m.counters["serve.shed.fake"] == 1
+        assert m.gauges["serve.shedding.fake"] == 1
+        assert "serve.brownout_shed.fake" not in m.counters
+    finally:
+        b.kill()
+
+
+def test_brownout_sheds_only_droppable_priorities():
+    ep = _FakeEndpoint()
+    replies, reply = _collecting_reply()
+    m = Metrics()
+    burning = {"on": True}
+    b = MicroBatcher(ep, reply, max_wait_s=10.0, metrics=m,
+                     brownout_fn=lambda: burning["on"],
+                     brownout_min_priority=1)
+    try:
+        assert b.submit(_msg(0, priority=0))     # droppable: shed
+        assert b.submit(_msg(1, priority=1))     # declared precious: kept
+        burning["on"] = False
+        assert b.submit(_msg(2, priority=0))     # healthy again: kept
+        shed = [r for r in replies if not r["ok"]]
+        assert [r["id"] for r in shed] == ["t-0"]
+        assert shed[0]["error"].startswith(protocol.ERR_OVERLOADED)
+        assert "brownout" in shed[0]["error"]
+        assert m.counters["serve.shed.fake"] == 1
+        assert m.counters["serve.brownout_shed.fake"] == 1
+        assert b.pending() == 2
+        # the accept path clears the shedding gauge — operators see the
+        # brownout END, not a latched alarm
+        assert m.gauges["serve.shedding.fake"] == 0
+    finally:
+        b.kill()
+
+
+def test_deadline_beats_shed_with_exactly_one_reply():
+    """A request that is BOTH past its deadline AND facing a full queue
+    gets exactly one reply, and it is deadline-exceeded — shedding an
+    already-dead request as 'retryable' would invite a pointless
+    resubmit (ISSUE 16 satellite)."""
+    ep = _FakeEndpoint()
+    replies, reply = _collecting_reply()
+    m = Metrics()
+    b = MicroBatcher(ep, reply, max_wait_s=10.0, max_queue=1, metrics=m)
+    try:
+        assert b.submit(_msg(0))                 # fills the queue
+        assert b.submit(_msg(1, deadline_ts=time.time() - 1.0))
+        mine = [r for r in replies if r["id"] == "t-1"]
+        assert len(mine) == 1                    # exactly ONE reply
+        assert mine[0]["ok"] is False
+        assert mine[0]["error"].startswith(protocol.ERR_DEADLINE)
+        assert "retry_after_s" not in mine[0]
+        assert m.counters["serve.deadline_expired.fake"] == 1
+        assert "serve.shed.fake" not in m.counters
+    finally:
+        b.kill()
+
+
+def test_retry_after_tracks_observed_dispatch_wall():
+    """Once dispatches have been observed, retry_after_s is backlog x the
+    EWMA dispatch wall — the server's own drain estimate, not a constant."""
+    ep = _FakeEndpoint()
+    replies, reply = _collecting_reply()
+    b = MicroBatcher(ep, reply, max_wait_s=0.005, max_batch=4, max_queue=4)
+    try:
+        b.submit(_msg(0))
+        deadline = time.time() + 5.0
+        while not replies and time.time() < deadline:
+            time.sleep(0.005)
+        assert replies and replies[0]["ok"]      # one dispatch observed
+        with b._cv:
+            ewma = b._dispatch_ewma
+        assert ewma is not None and ewma > 0.0
+        with b._cv:
+            assert b._retry_after_locked(8) == \
+                pytest.approx(2 * ewma + b.max_wait_s)
+    finally:
+        b.drain_and_stop()
+
+
+# --------------------------------------------------------------------------- #
+# Client: duplicate-reply idempotence (the netdup seam, satellite S2)
+# --------------------------------------------------------------------------- #
+
+def test_duplicate_reply_is_dropped_counted_and_never_corrupts():
+    m = Metrics()
+    client = RouterClient(100, {}, {}, metrics=m)
+    try:
+        pending = _PendingReply()
+        with client._lock:
+            client._waiting["rid-1"] = (0, pending)
+        reply = {"kind": protocol.REPLY, "id": "rid-1", "ok": True,
+                 "result": 42}
+        # the netdup'd wire delivers the same reply frame twice: the first
+        # copy resolves the future, the second finds no waiting id
+        client.queue.put(Event(EventType.MESSAGE, 0, dict(reply)))
+        client.queue.put(Event(EventType.MESSAGE, 0, dict(reply)))
+        assert pending.result(5.0) == 42
+        deadline = time.time() + 5.0
+        while (m.counters.get("serve.client.orphan_replies", 0) < 1
+               and time.time() < deadline):
+            time.sleep(0.005)
+        assert m.counters["serve.client.orphan_replies"] == 1
+        assert client._waiting == {}             # nothing left behind
+        assert pending.reply["result"] == 42     # first copy untouched
+    finally:
+        client.close()
+
+
+# --------------------------------------------------------------------------- #
+# Circuit breaker: open / fail-fast / half-open probe / close
+# --------------------------------------------------------------------------- #
+
+def test_breaker_opens_probes_and_closes():
+    m = Metrics()
+    client = RouterClient(100, {}, {"mf": 0}, metrics=m,
+                          breaker_threshold=2, breaker_cooldown_s=0.05)
+    try:
+        assert client.breaker_state(0) == "closed"
+        client._breaker_failure(0)
+        assert client.breaker_state(0) == "closed"     # under threshold
+        client._breaker_failure(0)
+        assert client.breaker_state(0) == "open"
+        assert m.counters["serve.client.breaker_open"] == 1
+        # open: submits fail fast without dialing
+        with pytest.raises(ConnectionError, match="circuit open"):
+            client._breaker_admit(0)
+        assert m.counters["serve.client.breaker_fastfail"] == 1
+        time.sleep(0.06)
+        # after the cooldown the FIRST caller is the single half-open
+        # probe; a second concurrent caller still fails fast
+        client._breaker_admit(0)
+        assert client.breaker_state(0) == "half-open"
+        with pytest.raises(ConnectionError):
+            client._breaker_admit(0)
+        # failed probe: re-open, cooldown re-armed
+        client._breaker_failure(0)
+        assert client.breaker_state(0) == "open"
+        assert m.counters["serve.client.breaker_open"] == 2
+        time.sleep(0.06)
+        client._breaker_admit(0)
+        client._breaker_success(0)                     # probe answered
+        assert client.breaker_state(0) == "closed"
+        assert m.counters["serve.client.breaker_closed"] == 1
+        # other ranks were never affected
+        assert client.breaker_state(1) == "closed"
+    finally:
+        client.close()
+
+
+def test_breaker_opens_from_real_connect_failures_and_placement_resets():
+    """Real transport leg: consecutive connection-refused sends open the
+    circuit (fast-fail, nothing dialed), and a placement frame
+    re-announcing the rank resets its breaker — the supervisor vouches
+    for the new address."""
+    import socket
+
+    # a port that refuses connections: bind, then close without listening
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_addr = s.getsockname()
+    s.close()
+    m = Metrics()
+    client = RouterClient(100, {0: dead_addr}, {"mf": 0}, metrics=m,
+                          breaker_threshold=2, breaker_cooldown_s=60.0)
+    try:
+        for _ in range(2):
+            with pytest.raises(ConnectionError):
+                client.submit(OP_TOPK, "mf", 1)
+        assert client.breaker_state(0) == "open"
+        t0 = time.perf_counter()
+        with pytest.raises(ConnectionError, match="circuit open"):
+            client.submit(OP_TOPK, "mf", 1)
+        # fail-fast means no dial: the open-circuit path never pays the
+        # transport's connect/retry budget
+        assert time.perf_counter() - t0 < 0.5
+        assert m.counters["serve.client.breaker_fastfail"] >= 1
+        client.apply_placement({"mf": 0}, {0: dead_addr}, version=1)
+        assert client.breaker_state(0) == "closed"
+    finally:
+        client.close()
+
+
+# --------------------------------------------------------------------------- #
+# request_retry: overloaded is transient, retry_after_s honored, no resync
+# --------------------------------------------------------------------------- #
+
+def _overloaded_error(retry_after_s):
+    err = protocol.ServeError(
+        f"{protocol.ERR_OVERLOADED}: queue shed at depth 3")
+    err.reply = {"ok": False, "retry_after_s": retry_after_s}
+    return err
+
+
+class _ScriptedPending:
+    def __init__(self, outcome):
+        self._outcome = outcome
+
+    def result(self, timeout=None):
+        if isinstance(self._outcome, Exception):
+            raise self._outcome
+        return self._outcome
+
+
+def test_request_retry_honors_retry_after_without_placement_resync():
+    m = Metrics()
+    client = RouterClient(100, {}, {"mf": 0}, metrics=m)
+    outcomes = [_overloaded_error(0.4), _overloaded_error(99.0), "answer"]
+    submits, naps, resyncs = [], [], []
+
+    def fake_submit(op, model, data, *, dest=None, priority=0):
+        submits.append(priority)
+        return _ScriptedPending(outcomes[len(submits) - 1])
+
+    client.submit = fake_submit
+    client.sync_placement = lambda timeout=5.0: resyncs.append(timeout)
+    try:
+        res = client.request_retry(OP_TOPK, "mf", 1, attempts=5,
+                                   backoff_s=0.001, backoff_max_s=0.002,
+                                   jitter=0.0, priority=2,
+                                   retry_after_cap_s=0.5,
+                                   sleep=naps.append)
+        assert res == "answer"
+        assert submits == [2, 2, 2]              # priority rides through
+        # backoff honored the server's drain estimate (0.4 > the
+        # exponential schedule), and the cap defanged the corrupt 99 s
+        assert naps[0] == pytest.approx(0.4)
+        assert naps[1] == pytest.approx(0.5)
+        assert resyncs == []                     # the map did not change
+        assert m.counters["serve.client_overloaded"] == 2
+    finally:
+        client.close()
+
+
+def test_request_retry_overloaded_exhausts_budget_loudly():
+    client = RouterClient(100, {}, {"mf": 0}, metrics=Metrics())
+    client.submit = lambda *a, **kw: _ScriptedPending(_overloaded_error(0.01))
+    client.sync_placement = lambda timeout=5.0: True
+    try:
+        with pytest.raises(protocol.ServeError, match="overloaded"):
+            client.request_retry(OP_TOPK, "mf", 1, attempts=3,
+                                 backoff_s=0.001, jitter=0.0,
+                                 sleep=lambda s: None)
+    finally:
+        client.close()
+
+
+# --------------------------------------------------------------------------- #
+# Worker path: cache hits are served even while the batcher browns out
+# --------------------------------------------------------------------------- #
+
+class _FakeBurningSLO:
+    burning = True
+
+    def is_burning(self):
+        return True
+
+    def observe(self, *a, **kw):
+        pass
+
+    def close(self):
+        pass
+
+
+def test_cache_hits_served_during_brownout(session, rng):
+    from harp_tpu.serve import TopKEndpoint
+
+    uf = rng.normal(size=(16, 4)).astype(np.float32)
+    items = rng.normal(size=(8, 4)).astype(np.float32)
+    ep = TopKEndpoint(session, "mf", uf, items, k=2)
+    cache = TopKReplyCache()
+    m = Metrics()
+    workers, make_client = local_gang(session, [{"mf": ep}], cache=cache,
+                                      metrics=m, brownout_min_priority=1)
+    client = make_client()
+    try:
+        ref = np.argsort(-(uf[3] @ items.T), kind="stable")[:2].tolist()
+        # warm the cache while healthy
+        assert client.request(OP_TOPK, "mf", 3, timeout=30.0)["items"] \
+            == ref
+        # arm a sustained brownout: every sub-priority-1 request is shed
+        workers[0].slo = _FakeBurningSLO()
+        with pytest.raises(protocol.ServeError, match="overloaded"):
+            client.request(OP_TOPK, "mf", 5, timeout=30.0)
+        # ...but the hot key still answers from the cache — brownout sheds
+        # WORK, not hits (cache sits before admission in the worker)
+        assert client.request(OP_TOPK, "mf", 3, timeout=30.0)["items"] \
+            == ref
+        # and declared-precious traffic is never browned out
+        assert client.request(OP_TOPK, "mf", 5, timeout=30.0,
+                              priority=1)["items"] == \
+            np.argsort(-(uf[5] @ items.T), kind="stable")[:2].tolist()
+        assert m.counters["serve.brownout_shed.mf"] == 1
+    finally:
+        client.close()
+        for w in workers:
+            w.close()

@@ -331,6 +331,46 @@ CLAIMS: List[Claim] = [
           r"Rebalanced top-k lookup \(serve_topk_mf_rebalanced\) \| (\S+) B",
           ("targets", "serve_topk_mf_rebalanced", "bytes_per_step"),
           rel_tol=0.0, file="tools/collective_budget.json"),
+    # PERF.md r17 + README "Overload resilience" (ISSUE 16): the autoscale
+    # ramp row. Throughput/latency/request-count inherit the wide recovery
+    # bands (a time-bounded closed-loop ramp on a loaded CPU varies run to
+    # run); the SHAPE claims are exact — peak/final worker count, the
+    # scale-up's zero-trace AOT install (summed over whichever model moved;
+    # the picked model varies with load), and the scale-down's placement
+    # version. A re-measure that changes the shape must rewrite the prose.
+    Claim("autoscale_requests", "PERF.md",
+          r"(\S+)\s+requests answered",
+          ("serving_fleet", "autoscale", "requests"), rel_tol=0.5),
+    Claim("autoscale_qps", "PERF.md",
+          r"(\S+) QPS at p50",
+          ("serving_fleet", "autoscale", "qps"), rel_tol=0.5),
+    Claim("autoscale_p50", "PERF.md",
+          r"QPS at p50 (\S+) ms",
+          ("serving_fleet", "autoscale", "p50_ms"), rel_tol=0.5),
+    Claim("autoscale_peak", "PERF.md",
+          r"\(peak (\d+), final",
+          ("serving_fleet", "autoscale", "peak_workers"), rel_tol=0.0),
+    Claim("autoscale_final", "PERF.md",
+          r"peak \d+, final (\d+)\)",
+          ("serving_fleet", "autoscale", "final_workers"), rel_tol=0.0),
+    Claim("autoscale_up_traces", "PERF.md",
+          r"`trace_counts = (\d+)`",
+          lambda b: float(sum(b["serving_fleet"]["autoscale"]["scale_up"]
+                              ["trace_counts"].values())), rel_tol=0.0),
+    Claim("autoscale_up_aot_buckets", "PERF.md",
+          r"`aot_loaded = (\d+)`",
+          lambda b: float(sum(b["serving_fleet"]["autoscale"]["scale_up"]
+                              ["aot_loaded"].values())), rel_tol=0.0),
+    Claim("autoscale_prebuild_s", "PERF.md",
+          r"pre-warmed offline in (\S+) s",
+          ("serving_fleet", "autoscale", "prebuild_s"), rel_tol=0.5),
+    Claim("autoscale_down_version", "PERF.md",
+          r"driving placement\s+version (\d+)",
+          ("serving_fleet", "autoscale", "scale_down", "placement_version"),
+          rel_tol=0.0),
+    Claim("autoscale_peak_readme", "README.md",
+          r"drive workers 1 → (\d+) → 1",
+          ("serving_fleet", "autoscale", "peak_workers"), rel_tol=0.0),
 ]
 
 

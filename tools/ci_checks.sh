@@ -96,6 +96,17 @@
 #                     versioned-swap (push_epoch) code in place by
 #                     stages 1-2: version state is host-side only and
 #                     never enters a traced dispatch.
+#   8. overload chaos — ISSUE 16: the overload-resilient serving story
+#                     end to end (tools/overload_chaos_smoke.py): a QPS
+#                     ramp with scripted wire faults (netdrop) AND a
+#                     scripted kill, while the demand-driven autoscaler
+#                     grows/shrinks the fleet through the versioned-
+#                     placement push — every request answered correctly
+#                     or cleanly shed with a retryable ``overloaded``
+#                     reply (0 failed / 0 wrong / 0 hung), worker count
+#                     follows the ramp up AND down, the kill recovers
+#                     mid-storm, and fresh workers install untraced
+#                     (trace_counts 0) behind a versioned placement.
 #
 # Any stage failing fails the script; all stages always run (a lint
 # finding must not hide a test regression or vice versa).
@@ -104,15 +115,15 @@ set -u
 cd "$(dirname "$0")/.."
 rc=0
 
-echo "== [1/7] jaxlint (AST + JL3xx concurrency + jaxpr + gang budgets + artifact manifest) =="
+echo "== [1/8] jaxlint (AST + JL3xx concurrency + jaxpr + gang budgets + artifact manifest) =="
 python -m tools.jaxlint || rc=1
 
-echo "== [2/7] jaxlint budget with telemetry + request tracing ON (zero drift) =="
+echo "== [2/8] jaxlint budget with telemetry + request tracing ON (zero drift) =="
 tele_dir="$(mktemp -d /tmp/_tele_gate.XXXXXX)"
 HARP_TELEMETRY_DIR="$tele_dir" HARP_TRACE_REQUESTS=1 \
     python -m tools.jaxlint --jaxpr-only || rc=1
 
-echo "== [3/7] gang-mode collective budgets (virtual multi-process mesh) =="
+echo "== [3/8] gang-mode collective budgets (virtual multi-process mesh) =="
 # ISSUE 13: the dryrun_multichip gang-mode step programs traced on the
 # virtual 2-host x 4-device mesh with the workers axis hinted DCN —
 # counts, per-process shard shapes, and the DCN/ICI link-class byte split
@@ -123,10 +134,10 @@ echo "== [3/7] gang-mode collective budgets (virtual multi-process mesh) =="
 # its own stage banner in CI output instead of buried in stage 1's.
 python -m tools.jaxlint --gang-only || rc=1
 
-echo "== [4/7] check_claims =="
+echo "== [4/8] check_claims =="
 python tools/check_claims.py || rc=1
 
-echo "== [5/7] tier-1 tests =="
+echo "== [5/8] tier-1 tests =="
 set -o pipefail
 t1_log="$(mktemp /tmp/_t1.XXXXXX.log)"   # unique per run: concurrent CI
 trap 'rm -f "$t1_log"; rm -rf "$tele_dir"' EXIT   # must not clobber the count
@@ -136,13 +147,16 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$t1_log" \
     | tr -cd . | wc -c)"
 
-echo "== [6/7] serving-chaos smoke (scripted kill under load, zero failures) =="
+echo "== [6/8] serving-chaos smoke (scripted kill under load, zero failures) =="
 # bounded like stage 5: a wedged recovery (the exact machinery this smoke
 # exercises) must fail CI, never hang it
 timeout -k 10 300 python -m tools.serving_chaos_smoke || rc=1
 
-echo "== [7/7] aot artifact round-trip (export -> hash-check -> load -> parity) =="
+echo "== [7/8] aot artifact round-trip (export -> hash-check -> load -> parity) =="
 timeout -k 10 300 python -m tools.aot_roundtrip_smoke || rc=1
+
+echo "== [8/8] overload + network chaos smoke (QPS ramp + netdrop + kill, autoscale up/down, zero failures) =="
+timeout -k 10 300 python -m tools.overload_chaos_smoke || rc=1
 
 if [ "$rc" -ne 0 ]; then
     echo "ci_checks: FAILED"

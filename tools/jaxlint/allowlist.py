@@ -134,6 +134,11 @@ ALLOWLIST: Allowlist = {
         "hot-key pass load thread: same zero-failures tally contract as "
         "client_loop — per-request failures are the measurement, not a "
         "crash",
+    ("harp_tpu/benchmark/serving_fleet.py", "load", "JL105"):
+        "autoscale-ramp load thread: same zero-failures tally contract "
+        "as client_loop — anything past the shed/retry classification "
+        "must land in the errors field or the closed loop's join hangs "
+        "and the row loses the failed request it exists to count",
     ("harp_tpu/serve/batcher.py", "_dispatch", "JL105"):
         "a malformed query payload in a coalesced serving batch can raise "
         "anything from dtype casts to shape errors deep in the dispatch; "

@@ -1,0 +1,188 @@
+"""CI overload + network chaos smoke (ISSUE 16): a QPS ramp against a
+one-worker gang with scripted wire faults (``netdrop``) and a scripted
+worker kill, while the demand-driven autoscaler grows and shrinks the
+fleet underneath the traffic.
+
+The contract asserted here is the overload-resilient serving story end to
+end:
+
+* every request is answered CORRECTLY or cleanly shed with a retryable
+  ``overloaded`` reply — zero failed, zero wrong, zero hung;
+* the autoscaler's trajectory follows the ramp UP (a scale-up journaled
+  with its pushed placement version and the fresh endpoints' zero trace
+  counts) and back DOWN once the ramp subsides;
+* the scripted kill rides the same storm: the fleet supervisor replaces
+  the corpse and restores its shards through the reshard engine with the
+  retry layer hiding all of it;
+* the dropped frames are survived by the client retry contract (the
+  retry counter is asserted — a run where nothing retried did not test
+  the seam).
+
+Exit 0 = contract held. Run: ``python -m tools.overload_chaos_smoke``
+(stage 8 of ci_checks.sh).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+
+def main() -> int:
+    from tools.jaxlint.trace_targets import ensure_cpu_mesh
+
+    ensure_cpu_mesh()
+    import numpy as np
+
+    from harp_tpu.serve import OP_TOPK, protocol
+    from harp_tpu.serve import fleet as fleet_mod
+    from harp_tpu.serve.autoscaler import Autoscaler
+    from harp_tpu.serve.router import local_gang
+    from harp_tpu.session import HarpSession
+    from harp_tpu.utils.metrics import DEFAULT as metrics
+
+    sess = HarpSession(num_workers=8)
+    specs = {f"m{i}": {"kind": "topk", "num_users": 32, "num_items": 16,
+                       "rank": 4, "k": 3, "seed": i} for i in range(3)}
+    eps = {name: fleet_mod.build_endpoint(sess, name, sp)
+           for name, sp in specs.items()}
+    workers, mk = local_gang(sess, [eps], max_wait_s=0.005, max_queue=48,
+                             client_rank_base=1000)
+
+    def builder(name, version):
+        return fleet_mod.build_endpoint(sess, name, specs[name],
+                                        version=version, restore=True)
+
+    canonical = {name: (lambda v, sp=sp: fleet_mod.topk_factors(sp, v)[0])
+                 for name, sp in specs.items()}
+    fleet = fleet_mod.LocalFleet(workers, mk, canonical=canonical,
+                                 endpoint_builder=builder, metrics=metrics)
+    refs = {}
+    for name, sp in specs.items():
+        uf, vf = fleet_mod.topk_factors(sp, 0)
+        refs[name] = fleet_mod.topk_reference(uf, vf, sp["k"])
+
+    failures = []
+    shed = [0]
+    served = [0]
+    tally_lock = threading.Lock()
+    stop = threading.Event()
+
+    def load(tid: int) -> None:
+        c = fleet.make_client()
+        rng = np.random.default_rng(tid)
+        try:
+            while not stop.is_set():
+                name = f"m{rng.integers(0, len(specs))}"
+                u = int(rng.integers(0, 32))
+                try:
+                    r = c.request_retry(OP_TOPK, name, u, timeout=10.0,
+                                        attempts=10, backoff_max_s=0.5,
+                                        sync_timeout=2.0)
+                    with tally_lock:
+                        served[0] += 1
+                    if r["items"] != refs[name][u]:
+                        failures.append((tid, name, u, "wrong", r["items"]))
+                except protocol.ServeError as e:
+                    # a shed that survived the whole retry budget is a
+                    # CLEAN outcome (retryable reply, client chose to give
+                    # up) — anything else server-reported is a failure
+                    if str(e).startswith(protocol.ERR_OVERLOADED):
+                        with tally_lock:
+                            shed[0] += 1
+                    else:
+                        failures.append((tid, name, u, repr(e)))
+                except Exception as e:  # noqa: BLE001 — tally IS the gate
+                    failures.append((tid, name, u, repr(e)))
+        finally:
+            c.close()
+
+    asc = Autoscaler(fleet, metrics=metrics, poll_interval_s=0.05,
+                     up_depth=6.0, down_depth=0.5, up_streak=2,
+                     down_streak=10, cooldown_s=0.5, max_workers=3,
+                     models_per_move=1)
+    threads = [threading.Thread(target=load, args=(i,)) for i in range(10)]
+    try:
+        # warm every model's dispatch before the chaos arms
+        warm = fleet.make_client()
+        for name in specs:
+            warm.request_retry(OP_TOPK, name, 0, timeout=60.0)
+        warm.close()
+        # the storm: from here on frames get eaten and rank 0 dies at its
+        # 60th request, all while the ramp drives the autoscaler
+        os.environ["HARP_FAULT"] = \
+            "netdrop@request=40,kill@request=60:rank=0"
+        for t in threads:
+            t.start()
+        peak, t0 = 1, time.monotonic()
+        while time.monotonic() - t0 < 30.0:
+            peak = max(peak, fleet.worker_count())
+            if peak >= 2 and time.monotonic() - t0 >= 8.0:
+                break
+            time.sleep(0.05)
+        stop.set()
+        hung = []
+        for t in threads:
+            t.join(30.0)
+            if t.is_alive():
+                hung.append(t.name)
+        # ramp over: the controller must unwind the shape it built
+        t1 = time.monotonic()
+        while time.monotonic() - t1 < 30.0 and fleet.worker_count() > 1:
+            time.sleep(0.1)
+        t2 = time.monotonic()
+        while (time.monotonic() - t2 < 10.0
+               and not any(r["action"] == "scale-down"
+                           for r in asc.trajectory())):
+            time.sleep(0.05)
+    finally:
+        os.environ.pop("HARP_FAULT", None)
+        stop.set()
+        asc.close()
+    events = [r["event"] for r in fleet.journal.records]
+    acts = [r.get("action") for r in fleet.journal.records
+            if r["event"] == "autoscale-decision"]
+    final = fleet.worker_count()
+    fleet.close()
+    if failures:
+        print(f"overload_chaos_smoke: FAILED — {len(failures)} failed/"
+              f"wrong request(s): {failures[:5]}")
+        return 1
+    if hung:
+        print(f"overload_chaos_smoke: FAILED — hung load threads: {hung}")
+        return 1
+    if peak < 2 or final != 1:
+        print(f"overload_chaos_smoke: FAILED — worker count did not follow "
+              f"the ramp (peak {peak}, final {final}; decisions {acts})")
+        return 1
+    if "scale-up" not in acts or "scale-down" not in acts:
+        print(f"overload_chaos_smoke: FAILED — trajectory missing a move "
+              f"({acts})")
+        return 1
+    if "worker-death" not in events or "replaced" not in events:
+        print(f"overload_chaos_smoke: FAILED — the scripted kill did not "
+              f"recover (journal: {events})")
+        return 1
+    up = next(r for r in fleet.journal.records if r["event"] == "scale-up")
+    if any(v != 0 for v in up["trace_counts"].values()) \
+            or not up.get("placement_version"):
+        print(f"overload_chaos_smoke: FAILED — scale-up record malformed "
+              f"(fresh worker must start untraced, placement versioned): "
+              f"{up}")
+        return 1
+    retries = metrics.counters.get("serve.client_retries", 0)
+    if retries < 1:
+        print("overload_chaos_smoke: FAILED — nothing retried: the wire "
+              "faults/kill cannot have fired")
+        return 1
+    print(f"overload_chaos_smoke: OK — {served[0]} served correctly, "
+          f"{shed[0]} cleanly shed, 0 failed/wrong/hung across a QPS ramp "
+          f"with netdrop + a scripted kill; workers 1 -> {peak} -> {final} "
+          f"({retries:.0f} client retries, journal: {events})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
