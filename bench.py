@@ -994,6 +994,25 @@ def tpu_serving(small=False):
     return row
 
 
+def tpu_serving_quant(small=False):
+    """Quantized-serving rows (ISSUE 17 acceptance): f32 vs int8 resident
+    gangs at the recsys bench shapes (2048 users x 512 items, rank 64,
+    k=10) — per-mix QPS/p99 for both modes measured by the same closed-
+    loop machinery, per-model resident_bytes + the f32/int8 reduction
+    ratio, and the sampled top-k overlap through the full quantized
+    request path (int8 dispatch wire + f16-encoded replies). The
+    acceptance bars (resident reduction >= 3x on the top-k model, mean
+    overlap >= 0.95) are gated AFTER the record commits, like
+    telemetry_overhead. resident_bytes and overlap are device-independent;
+    a CPU-mesh row carries the latency re-measure note."""
+    from harp_tpu.benchmark import serving_quant
+    from harp_tpu.session import HarpSession
+
+    return serving_quant.measure(
+        HarpSession(), requests_per_mix=200 if small else 600,
+        overlap_sample=64 if small else 128, num_clients=3)
+
+
 def tpu_serving_fleet(small=False):
     """Fleet-operations rows (ISSUE 14 acceptance): the recovery-blip run
     (a SEPARATE-PROCESS serving gang under retrying load absorbs a
@@ -1173,7 +1192,7 @@ ROW_GROUPS = ("kmeans", "kmeans_padded128", "kmeans_csr", "sgd_mf", "als",
               "nn_compute_bound", "attention", "attention_blocksparse",
               "kernel_svm", "mds", "sort", "csr_cov", "kmeans_from_files",
               "p2p", "mesh", "collectives_quantized", "telemetry_overhead",
-              "ring_dma_overlap", "serving", "reshard")
+              "ring_dma_overlap", "serving", "serving_quant", "reshard")
 
 
 def main():
@@ -1643,6 +1662,36 @@ def main():
                     (sum(asc_up["trace_counts"].values())
                      if asc_up.get("trace_counts") else None)})
 
+    if want("serving_quant"):
+        begin("serving_quant")
+        try:
+            qsrow = tpu_serving_quant(small)
+        except Exception as e:     # noqa: BLE001 — bench must not die here
+            qsrow = {"error": str(e)[:200]}
+        detail["serving_quant"] = qsrow
+        detail["bench_schema_note_r17"] = (
+            "r17 adds the serving_quant group (bench.py --only "
+            "serving_quant): f32 vs int8 resident serving gangs at the "
+            "recsys bench shapes (2048x512, rank 64, k=10) — per-mix "
+            "QPS/p99 for both modes, per-model resident_bytes with the "
+            "f32/int8 reduction ratio, and the sampled top-k overlap "
+            "through the full quantized path (int8 dispatch wire, "
+            "f16-encoded replies). resident_bytes and overlap are "
+            "device-independent; on a CPU-mesh session the latency "
+            "columns price CPU dispatches and the driver's on-chip run "
+            "re-measures them (same schema, device='tpu').")
+        if isinstance(qsrow, dict) and "resident_reduction" in qsrow:
+            i8 = qsrow["modes"]["int8"]["mixes"].get("topk_heavy", {})
+            f32 = qsrow["modes"]["f32"]["mixes"].get("topk_heavy", {})
+            compact.update({
+                "serving_quant_topk_reduction":
+                    qsrow["resident_reduction"].get("topk"),
+                "serving_quant_overlap_mean":
+                    qsrow["topk_overlap"]["mean"],
+                "serving_quant_int8_p99_ms": i8.get("p99_ms"),
+                "serving_quant_f32_p99_ms": f32.get("p99_ms"),
+                "serving_quant_device": qsrow.get("device")})
+
     if want("reshard"):
         begin("reshard")
         try:
@@ -1698,6 +1747,15 @@ def main():
             f"bench: telemetry_overhead contract FAILED "
             f"({trow['overhead_pct']}% >= 2%)\n")
         sys.exit(1)
+    qsrow = detail.get("serving_quant")
+    if isinstance(qsrow, dict) and "resident_reduction" in qsrow:
+        red = qsrow["resident_reduction"].get("topk") or 0.0
+        ovl = qsrow["topk_overlap"]["mean"]
+        if red < 3.0 or ovl < 0.95:
+            sys.stderr.write(
+                f"bench: serving_quant contract FAILED (topk resident "
+                f"reduction {red}x < 3x or overlap {ovl} < 0.95)\n")
+            sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -74,7 +74,10 @@ def _key(ep, bucket: int, args, model_hash: Optional[str]) -> ArtifactKey:
                            owner_routed=getattr(ep, "_owner_routed", False)),
         world=ep.session.num_workers,
         layout=layout_of(args),
-        model_hash=model_hash or endpoint_model_hash(ep))
+        model_hash=model_hash or endpoint_model_hash(ep),
+        # the quant axis (ISSUE 17): an f32-keyed artifact is a LOUD
+        # metered miss_quant for an int8 endpoint, never a silent install
+        quant=getattr(ep, "quant", None) or "f32")
 
 
 def export_endpoint(store: ArtifactStore, ep, *,

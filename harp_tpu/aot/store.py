@@ -20,6 +20,11 @@ only served when every axis matches the loading process —
   must not run on another (``miss_device_kind``);
 * ``world``        — the mesh width baked into the program
   (``miss_world``);
+* ``quant``        — the resident quant mode the program was exported
+  under (``"f32"`` or ``"int8"`` — ISSUE 17): an int8 artifact must never
+  warm an f32 endpoint or vice versa (``miss_quant``). Checked BEFORE
+  layout so a pure quant flip names itself instead of surfacing as the
+  layout drift its dtype shift also causes;
 * ``layout``       — the full abstract signature: shape/dtype/sharding of
   every argument, :func:`layout_of` (``miss_layout``);
 * ``model_hash``   — the model identity the program serves; the caller's
@@ -58,7 +63,8 @@ FMT_PICKLED = "pickled_executable"
 # the key axes checked at load, in check order: the FIRST mismatching axis
 # names the miss (a stale artifact usually fails several; one clear reason
 # beats four)
-KEY_AXES = ("jax_version", "device_kind", "world", "layout", "model_hash")
+KEY_AXES = ("jax_version", "device_kind", "world", "quant", "layout",
+            "model_hash")
 
 
 def jax_version() -> str:
@@ -119,6 +125,7 @@ class ArtifactKey:
     model_hash: str             # caller's model-identity content hash
     jax_version: str = field(default_factory=jax_version)
     device_kind: str = field(default_factory=device_kind)
+    quant: str = "f32"          # resident quant mode ("f32" | "int8")
 
 
 def _check_name(name: str) -> str:

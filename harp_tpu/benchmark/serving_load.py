@@ -69,13 +69,18 @@ def build_gang(session, *, num_users: int = 512, num_items: int = 256,
                rank: int = 8, k: int = 10, classify_dim: int = 16,
                num_classes: int = 3, max_wait_s: float = 0.002,
                seed: int = 0, metrics=None, trace_sample: int = 0,
-               slo_p99_s=None, slo_kw=None):
+               slo_p99_s=None, slo_kw=None, quant=None, accept_enc=None):
     """A 2-worker serving gang over synthetic trained state.
 
     Returns ``(workers, make_client, meta)`` — ``meta`` carries the
     id/feature spaces the load threads draw from. Factors are random
     (serving cost does not depend on their values); the tier-1 parity tests
     in tests/test_serve.py cover correctness against fitted models.
+
+    ``quant="int8"`` builds BOTH endpoints with int8 resident state and
+    ``accept_enc`` is forwarded to every client the returned factory makes
+    (ISSUE 17) — the quantized-serving bench compares two gangs built from
+    the same seed, one per mode.
     """
     from harp_tpu.models import nn
     from harp_tpu.serve import (TopKEndpoint, classify_from_nn, local_gang)
@@ -84,15 +89,16 @@ def build_gang(session, *, num_users: int = 512, num_items: int = 256,
     model = nn.MLPClassifier(session, nn.NNConfig(
         layers=(32,), num_classes=num_classes))
     model.params = nn.init_params((classify_dim, 32, num_classes), seed=seed)
-    ep_classify = classify_from_nn(session, model, name=CLASSIFY_MODEL)
+    ep_classify = classify_from_nn(session, model, name=CLASSIFY_MODEL,
+                                   quant=quant)
     user_factors = rng.normal(size=(num_users, rank)).astype(np.float32)
     item_factors = rng.normal(size=(num_items, rank)).astype(np.float32)
     ep_topk = TopKEndpoint(session, TOPK_MODEL, user_factors, item_factors,
-                           k=k, metrics=metrics)
+                           k=k, metrics=metrics, quant=quant)
     workers, make_client = local_gang(
         session, [{CLASSIFY_MODEL: ep_classify}, {TOPK_MODEL: ep_topk}],
         max_wait_s=max_wait_s, metrics=metrics, trace_sample=trace_sample,
-        slo_p99_s=slo_p99_s, slo_kw=slo_kw)
+        slo_p99_s=slo_p99_s, slo_kw=slo_kw, accept_enc=accept_enc)
     meta = {"num_users": num_users, "num_items": num_items, "rank": rank,
             "k": k, "classify_dim": classify_dim,
             "endpoints": {CLASSIFY_MODEL: ep_classify, TOPK_MODEL: ep_topk}}

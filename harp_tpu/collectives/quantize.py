@@ -42,6 +42,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from harp_tpu import combiner as combiner_lib
 from harp_tpu import compat
@@ -123,6 +124,60 @@ def ef_encode_flat(flat: jax.Array, residual: jax.Array, comm: CommConfig,
     y = flat + residual
     payload, scale, n = encode_flat(y, comm, block)
     return payload, scale, n, y - decode_flat(payload, scale, n, comm)
+
+
+# --------------------------------------------------------------------------- #
+# Packed-row codec: f32 factor rows <-> self-describing int8 rows
+# --------------------------------------------------------------------------- #
+#
+# The SERVING-path codec (ISSUE 17). A factor table row quantizes with one
+# symmetric per-ROW scale (the row is the dot-product unit, so a per-row
+# scale factors out of the score exactly), and the scale travels INSIDE the
+# row as its last 4 bytes (the f32 bitcast to int8). The packed row is one
+# homogeneous int8 vector, which is what makes it a drop-in KVStore value
+# dtype: it rides `DistributedKV.lookup`'s route-back all_to_all, the
+# reshard engine's restore/rebalance rounds, and `push_epoch`'s re-scatter
+# with zero extra bookkeeping — the scale can never be separated from the
+# row it describes. An all-zero row (a KVStore default / a reshard fill)
+# decodes to exactly 0.0: the bitcast of four zero bytes is +0.0f.
+
+ROW_SCALE_BYTES = 4          # one f32 scale, bitcast into the row's tail
+
+
+def encode_rows_np(rows: np.ndarray) -> np.ndarray:
+    """Host-side packed-row encode: f32 ``(..., r)`` -> int8 ``(..., r+4)``.
+
+    Symmetric per-row int8 (``scale = max|row| / 127``), scale appended as
+    its 4 raw bytes. Numpy's ``.view`` and the device-side
+    ``lax.bitcast_convert_type`` both reinterpret native-endian memory, so
+    the round trip is exact (pinned by tests/test_serve_quant.py)."""
+    rows = np.asarray(rows, np.float32)
+    scale = (np.max(np.abs(rows), axis=-1, keepdims=True)
+             / 127.0).astype(np.float32)
+    q = np.clip(np.rint(rows / np.maximum(scale, _TINY)),
+                -127, 127).astype(np.int8)
+    return np.concatenate([q, scale.view(np.int8)], axis=-1)
+
+
+def decode_rows(packed: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Device-side packed-row split: int8 ``(..., r+4)`` ->
+    (``(..., r)`` int8 quantized values, ``(...,)`` f32 per-row scales).
+    The scale comes back by bitcast — no arithmetic, bit-exact."""
+    q = packed[..., :-ROW_SCALE_BYTES]
+    scale = jax.lax.bitcast_convert_type(
+        packed[..., -ROW_SCALE_BYTES:], jnp.float32)
+    return q, scale
+
+
+def dequantize_rows(packed: jax.Array) -> jax.Array:
+    """Device-side packed-row decode back to f32 ``(..., r)``."""
+    q, scale = decode_rows(packed)
+    return q.astype(jnp.float32) * scale[..., None]
+
+
+def packed_row_width(r: int) -> int:
+    """Trailing width of a packed int8 row for rank-``r`` factors."""
+    return int(r) + ROW_SCALE_BYTES
 
 
 # --------------------------------------------------------------------------- #

@@ -215,9 +215,21 @@ class DistributedKV:
             back, ok = route_back(packed, routing, self.axis_name)
             back_f = (back[:, -1] > 0.5) & ok
             back_v = back[:, :-1].reshape((n,) + vshape).astype(vdtype)
+        elif vdtype == jnp.int8:
+            # int8 rows (the quantized serving payload, ISSUE 17): the
+            # found flag packs as one extra int8 column, so the whole
+            # answer rides ONE int8 route_back — the same collective count
+            # as the f32 pack at roughly a quarter of the bytes (the
+            # serve_topk_mf_int8 budget row pins exactly this)
+            flat = vals.reshape(w, cap, -1)
+            packed = jnp.concatenate(
+                [flat, found.reshape(w, cap, 1).astype(jnp.int8)], axis=-1)
+            back, ok = route_back(packed, routing, self.axis_name)
+            back_f = (back[:, -1] > 0) & ok
+            back_v = back[:, :-1].reshape((n,) + vshape)
         else:
-            # integer values would lose precision through an f32 pack —
-            # return values and flags in separate trips
+            # wider integer values would lose precision through an f32
+            # pack — return values and flags in separate trips
             back_v, ok = route_back(vals.reshape((w, cap) + vshape),
                                     routing, self.axis_name)
             back_f0, _ = route_back(found.reshape(w, cap), routing,

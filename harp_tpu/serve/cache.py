@@ -8,11 +8,14 @@ repeat lookups stop paying the route + dispatch entirely (and, when the
 front worker is not the owner, the forward hop too).
 
 Correctness under live refresh: every entry is keyed by the endpoint's
-factor-epoch ``version`` (the ``push_epoch`` counter). A refresh therefore
-invalidates the whole cached generation implicitly — a stale epoch's reply
-can never be served after the swap, without any flush coordination. Entries
-additionally expire after ``ttl_s`` and the store is LRU-bounded at
-``capacity`` (hot keys stay, the long tail churns through).
+factor-epoch ``version`` (the ``push_epoch`` counter) AND its resident
+``quant`` mode (ISSUE 17). A refresh therefore invalidates the whole cached
+generation implicitly — a stale epoch's reply can never be served after the
+swap, without any flush coordination — and a quant flip (an f32 endpoint
+replaced by its int8 twin at the same epoch, or back) can never serve the
+other mode's cached scores. Entries additionally expire after ``ttl_s`` and
+the store is LRU-bounded at ``capacity`` (hot keys stay, the long tail
+churns through).
 
 Thread model: one lock around the OrderedDict — ``get``/``put`` are called
 from the worker's receive thread (hit check) and every batcher thread
@@ -64,22 +67,24 @@ class TopKReplyCache:
         self.misses = 0
 
     @staticmethod
-    def _key(model: str, data: Any, version: Optional[int]):
+    def _key(model: str, data: Any, version: Optional[int],
+             quant: Optional[str] = None):
         """None = uncacheable (a non-scalar payload, or an unversioned
         endpoint — caching without a version key would serve stale epochs
-        after a refresh)."""
+        after a refresh). ``quant`` joins the key so the f32 and int8
+        modes of one model can never answer for each other."""
         if version is None:
             return None
         try:
-            return (model, int(data), int(version))
+            return (model, int(data), int(version), quant or "f32")
         except (TypeError, ValueError):
             return None
 
     def get(self, model: str, data: Any, version: Optional[int],
-            now: Optional[float] = None):
+            now: Optional[float] = None, quant: Optional[str] = None):
         """The cached reply result, or None. Expired/stale entries are
         evicted on the way out; every call tallies hit or miss."""
-        key = self._key(model, data, version)
+        key = self._key(model, data, version, quant)
         if key is None:
             return None
         now = time.time() if now is None else now
@@ -110,15 +115,17 @@ class TopKReplyCache:
         fill at a newer epoch retires this key for every router at
         once."""
         with self._lock:
-            version = self._latest.get(model)
-        if version is None:
+            latest = self._latest.get(model)
+        if latest is None:
             return None
-        hit = self.get(model, data, version, now=now)
+        version, quant = latest
+        hit = self.get(model, data, version, now=now, quant=quant)
         return None if hit is None else (hit, version)
 
     def put(self, model: str, data: Any, version: Optional[int],
-            result, now: Optional[float] = None) -> bool:
-        key = self._key(model, data, version)
+            result, now: Optional[float] = None,
+            quant: Optional[str] = None) -> bool:
+        key = self._key(model, data, version, quant)
         if key is None or result is None:
             return False
         now = time.time() if now is None else now
@@ -126,8 +133,13 @@ class TopKReplyCache:
             self._store[key] = (now + self.ttl_s, result)
             self._store.move_to_end(key)
             prev = self._latest.get(model)
-            if prev is None or key[2] > prev:
-                self._latest[model] = key[2]
+            if (prev is None or key[2] > prev[0]
+                    or (key[2] == prev[0] and key[3] != prev[1])):
+                # a newer epoch retires the old (version, quant) pair for
+                # every router at once; a quant flip AT the same epoch (a
+                # redeploy in the other mode) does too — latest fill wins,
+                # so no router can keep hitting the retired mode's entries
+                self._latest[model] = (key[2], key[3])
             while len(self._store) > self.capacity:
                 self._store.popitem(last=False)
         return True

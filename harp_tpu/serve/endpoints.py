@@ -44,7 +44,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from harp_tpu import keyval
+from harp_tpu.collectives import quantize
 from harp_tpu.session import HarpSession
+
+# resident quant modes an endpoint accepts (None = f32 everywhere — every
+# pre-ISSUE-17 program stays bit-identical, pinned by the budget manifest)
+QUANT_MODES = (None, "int8")
 
 # ONE process-wide gate serializing collective device programs (ISSUE 16).
 # The in-process gang shares a single virtual mesh: two collective programs
@@ -67,8 +72,19 @@ class Endpoint:
     # collectives: their device launches serialize on _COLLECTIVE_GATE
     collective_dispatch: bool = False
 
+    # resident quant mode (ISSUE 17): None = f32 residents; "int8" =
+    # packed-row residents (TopKEndpoint) / blockwise-encoded params
+    # (ClassifyEndpoint). Part of the AOT artifact key and the reply-cache
+    # key — a quant flip can never serve the other mode's program or a
+    # stale-dtype cached reply.
+    quant: Optional[str] = None
+
     def __init__(self, session: HarpSession, name: str,
-                 bucket_sizes: Optional[Sequence[int]] = None):
+                 bucket_sizes: Optional[Sequence[int]] = None,
+                 metrics=None):
+        if metrics is None:
+            from harp_tpu.utils.metrics import DEFAULT as metrics
+        self.metrics = metrics
         self.session = session
         self.name = name
         w = session.num_workers
@@ -109,6 +125,23 @@ class Endpoint:
     @property
     def max_batch(self) -> int:
         return self.bucket_sizes[-1]
+
+    def resident_bytes(self) -> int:
+        """Total logical bytes of the RESIDENT device state (factor
+        stores, replicated params/item tables) — the per-model memory
+        footprint the quantized mode exists to shrink, and the pressure
+        signal a model-mall LRU would evict on."""
+        with self._resident_lock:
+            state = self._state
+        return int(sum(int(a.nbytes)
+                       for a in jax.tree_util.tree_leaves(state)))
+
+    def _note_resident_bytes(self) -> None:
+        """Publish ``serve.resident_bytes.<model>`` (exported via
+        ``/metrics``). Called OUTSIDE the resident lock, after every state
+        construction or swap."""
+        self.metrics.gauge(f"serve.resident_bytes.{self.name}",
+                           float(self.resident_bytes()))
 
     def bucket_for(self, n: int) -> int:
         for b in self.bucket_sizes:
@@ -251,14 +284,60 @@ class ClassifyEndpoint(Endpoint):
 
     def __init__(self, session: HarpSession, name: str, predict_fn, params,
                  classes: Optional[np.ndarray] = None, dim: Optional[int] = None,
-                 bucket_sizes: Optional[Sequence[int]] = None):
-        super().__init__(session, name, bucket_sizes)
+                 bucket_sizes: Optional[Sequence[int]] = None,
+                 quant: Optional[str] = None, metrics=None):
+        super().__init__(session, name, bucket_sizes, metrics=metrics)
+        if quant not in QUANT_MODES:
+            raise ValueError(f"quant must be one of {QUANT_MODES}, "
+                             f"got {quant!r}")
+        self.quant = quant
         self._predict = predict_fn
+        if quant == "int8":
+            # int8 residents (ISSUE 17): every floating param leaf is
+            # stored as (int8 payload, per-block f32 scales) — the PR 6
+            # blockwise codec — and dequantized INSIDE the dispatch. The
+            # structure/shape metadata is host-side; the device state is a
+            # pure pytree of arrays, so replication/AOT layout
+            # fingerprinting work unchanged (and the dtype shift makes an
+            # int8 artifact a different layout by construction).
+            comm = quantize.CommConfig(quant="int8")
+            leaves, self._treedef = jax.tree_util.tree_flatten(params)
+            enc_leaves, meta = [], []
+            for leaf in leaves:
+                arr = jnp.asarray(leaf)
+                if jnp.issubdtype(arr.dtype, jnp.floating):
+                    flat = arr.astype(jnp.float32).reshape(-1)
+                    block = quantize._block_for(flat.shape[0], comm)
+                    payload, scale, n = quantize.encode_flat(
+                        flat, comm, block)
+                    enc_leaves.append((payload, scale))
+                    meta.append((n, tuple(arr.shape), arr.dtype, comm))
+                else:
+                    enc_leaves.append(arr)
+                    meta.append(None)
+            self._quant_meta = meta
+            params = tuple(enc_leaves)
         self._params = jax.device_put(
             params, session.sharding(session.replicate()))
         self.classes = None if classes is None else np.asarray(classes)
         self.dim = dim
         self._state = (self._params,)
+        self._note_resident_bytes()
+
+    def _dequant_params(self, enc):
+        """Rebuild the caller's param pytree from the encoded leaves —
+        runs INSIDE the traced dispatch (decode is elementwise, collective-
+        free: the serve_classify_nn zero-collective pin holds for int8)."""
+        leaves = []
+        for q_leaf, meta in zip(enc, self._quant_meta):
+            if meta is None:
+                leaves.append(q_leaf)
+                continue
+            n, shape, dtype, comm = meta
+            payload, scale = q_leaf
+            leaves.append(quantize.decode_flat(
+                payload, scale, n, comm).reshape(shape).astype(dtype))
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
 
     def _validate_data(self, data) -> Optional[str]:
         shape = np.shape(data)
@@ -274,6 +353,8 @@ class ClassifyEndpoint(Endpoint):
 
         def predict(params, x):
             self._count_trace(bucket)
+            if self.quant == "int8":
+                params = self._dequant_params(params)
             return self._predict(params, x)
 
         return sess.spmd(predict,
@@ -419,6 +500,20 @@ class TopKEndpoint(Endpoint):
     ``lax.top_k`` locally. Unknown ids come back ``found=False`` with empty
     recommendations, never a crash (``route_cap`` is the full local batch,
     so owner skew can never overflow a routing bucket).
+
+    ``quant="int8"`` (ISSUE 17) stores BOTH resident factor tables as
+    packed int8 rows (``quantize.encode_rows_np``: per-row max-abs scale
+    bitcast into the row's 4 trailing bytes) — the KV shards AND the
+    replicated item table — so the route-back all_to_all carries the int8
+    rows directly (~4x fewer wire bytes, pinned by the
+    ``serve_topk_mf_int8`` budget row; same 3 all_to_alls + 1 psum).
+    Scoring defaults to ``quant_score="int8_direct"``: an int8 x int8
+    ``dot_general`` accumulating in int32 (exact — max |sum| at serving
+    ranks is orders of magnitude under 2^31) scaled to f32 by the two
+    per-row scales, which the parity measurement showed identical (to f32
+    rounding) to the ``"dequant"`` alternative that materializes f32
+    operands first — so the cheaper MXU-native form is the default and
+    the dequant form stays selectable for A/B.
     """
 
     op = "topk"
@@ -428,11 +523,17 @@ class TopKEndpoint(Endpoint):
                  item_factors, k: int = 10,
                  user_ids: Optional[np.ndarray] = None,
                  bucket_sizes: Optional[Sequence[int]] = None,
-                 metrics=None):
-        if metrics is None:
-            from harp_tpu.utils.metrics import DEFAULT as metrics
-        self.metrics = metrics
-        super().__init__(session, name, bucket_sizes)
+                 metrics=None, quant: Optional[str] = None,
+                 quant_score: str = "int8_direct"):
+        super().__init__(session, name, bucket_sizes, metrics=metrics)
+        if quant not in QUANT_MODES:
+            raise ValueError(f"quant must be one of {QUANT_MODES}, "
+                             f"got {quant!r}")
+        if quant_score not in ("int8_direct", "dequant"):
+            raise ValueError(f"quant_score must be 'int8_direct' or "
+                             f"'dequant', got {quant_score!r}")
+        self.quant = quant
+        self.quant_score = quant_score
         uf = np.asarray(user_factors, np.float32)
         items = np.asarray(item_factors, np.float32)
         if uf.ndim != 2 or items.ndim != 2 or uf.shape[1] != items.shape[1]:
@@ -464,15 +565,35 @@ class TopKEndpoint(Endpoint):
         # shows first
         self._lookup_owner_counts = np.zeros(w, np.int64)
         self._dim = uf.shape[1]
+        # stored-row geometry: the reshard engine and the lookup wire both
+        # move rows of _val_width x _val_dtype — under int8 that is the
+        # PACKED row (factors + the bitcast scale), so a scale can never
+        # separate from its row through lookup, restore_shard, or rebalance
+        if quant == "int8":
+            self._val_width = quantize.packed_row_width(self._dim)
+            self._val_dtype = np.int8
+        else:
+            self._val_width = self._dim
+            self._val_dtype = np.float32
+        self._row_bytes = (self._val_width
+                           * np.dtype(self._val_dtype).itemsize)
         slot, counts, cap = self._kv_layout(self._owner)
         self._slot, self._counts, self._cap = slot, counts, cap
         keys = np.full((w, cap), keyval.EMPTY, np.int32)
-        vals = np.zeros((w, cap, uf.shape[1]), np.float32)
+        vals = np.zeros((w, cap, self._val_width), self._val_dtype)
         keys[self._owner, slot] = ids
-        vals[self._owner, slot] = uf
+        vals[self._owner, slot] = self._encode_vals(uf)
         self._state = (session.scatter(keys), session.scatter(vals),
                        session.scatter(counts.astype(np.int32)),
-                       session.replicate_put(items))
+                       session.replicate_put(self._encode_vals(items)))
+        self._note_resident_bytes()
+
+    def _encode_vals(self, rows: np.ndarray) -> np.ndarray:
+        """Factor rows in the endpoint's STORED form: packed int8 rows
+        under ``quant="int8"``, f32 passthrough otherwise."""
+        rows = np.asarray(rows, np.float32)
+        return (quantize.encode_rows_np(rows) if self.quant == "int8"
+                else rows)
 
     # -- shard bookkeeping (restore / rebalance ride collectives.reshard) -- #
 
@@ -532,13 +653,16 @@ class TopKEndpoint(Endpoint):
             vals_d, items = self._state[1], self._state[3]
             plan = rs.plan_moves(
                 mine, self._owner[mine] * self._cap + self._slot[mine],
-                len(uf), w * self._cap, w, self._dim * 4)
-            new_vals = rs.reshard(sess, uf, plan, vals_d)
+                len(uf), w * self._cap, w, self._row_bytes)
+            # the engine moves rows in the STORED form (packed int8 rows
+            # under quant="int8" — encode is host-side, pre-move)
+            new_vals = rs.reshard(sess, self._encode_vals(uf), plan, vals_d)
             # the key/count rows are host-known index arrays — re-scatter
             # them whole (tiny); only the factor payload needed the engine
             keys, counts = self._keys_counts(self._owner, self._slot,
                                              self._counts, self._cap)
             self._state = (keys, new_vals, counts, items) + self._state[4:]
+        self._note_resident_bytes()
         return len(mine)
 
     def restore_full(self, user_factors, *,
@@ -610,11 +734,12 @@ class TopKEndpoint(Endpoint):
             # layout, not payload — an epoch push reuses them as-is (the
             # state args are never donated; only the query buffer is).
             w = sess.num_workers
-            vals = np.zeros((w, cap, self._dim), np.float32)
-            vals[owner, slot] = uf
+            vals = np.zeros((w, cap, self._val_width), self._val_dtype)
+            vals[owner, slot] = self._encode_vals(uf)
             new_vals = sess.scatter(vals)
             new_items = (old_items if items_host is None
-                         else sess.replicate_put(items_host))
+                         else sess.replicate_put(
+                             self._encode_vals(items_host)))
             jax.block_until_ready((new_vals, new_items))
             with self._resident_lock:
                 if self._layout_gen != gen:
@@ -635,6 +760,7 @@ class TopKEndpoint(Endpoint):
             self.metrics.count(f"serve.refreshes.{self.name}")
             self.metrics.gauge(f"serve.version.{self.name}",
                                float(new_version))
+            self._note_resident_bytes()
             return new_version
 
     def rebalance(self, away_from) -> dict:
@@ -690,8 +816,9 @@ class TopKEndpoint(Endpoint):
             # source is the LIVE device array (flat order owner*cap + slot)
             plan = rs.plan_moves(
                 self._owner * self._cap + self._slot, owner * cap + slot,
-                w * self._cap, w * cap, w, self._dim * 4)
-            fill = sess.scatter(np.zeros((w, cap, self._dim), np.float32))
+                w * self._cap, w * cap, w, self._row_bytes)
+            fill = sess.scatter(
+                np.zeros((w, cap, self._val_width), self._val_dtype))
             new_vals = rs.reshard(sess, vals_d, plan, fill)
             self._owner, self._slot, self._counts, self._cap = (owner, slot,
                                                                 counts, cap)
@@ -711,6 +838,7 @@ class TopKEndpoint(Endpoint):
             # fns — the lazy rebuild may trace (allowed), and a later
             # artifact load for the new layout re-marks
             self.aot_loaded.clear()
+        self._note_resident_bytes()
         moved = int(plan.moved_rows)
         return {"moved": moved,
                 "owners": {int(r): int(c) for r, c in enumerate(counts)}}
@@ -732,10 +860,35 @@ class TopKEndpoint(Endpoint):
         k = self.k
         w = sess.num_workers
 
+        quant = self.quant
+        direct = self.quant_score == "int8_direct"
+
         def score_topk(w_q, found, items):
-            scores = jax.lax.dot_general(
-                w_q, items, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)
+            if quant == "int8":
+                if direct:
+                    # the JL202-clean int8 MXU form: int8 x int8 dot
+                    # accumulating in int32 (exact), then ONE f32 rescale
+                    # by the two per-row scales — the parity-measured
+                    # default (identical to "dequant" up to f32 rounding)
+                    q_u, s_u = quantize.decode_rows(w_q)
+                    q_v, s_v = quantize.decode_rows(items)
+                    acc = jax.lax.dot_general(
+                        q_u, q_v, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.int32)
+                    scores = (acc.astype(jnp.float32)
+                              * s_u[:, None] * s_v[None, :])
+                else:
+                    # dequantize-inside-dispatch: materialize f32 operands
+                    # then the plain f32 dot (the A/B alternative)
+                    scores = jax.lax.dot_general(
+                        quantize.dequantize_rows(w_q),
+                        quantize.dequantize_rows(items),
+                        (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+            else:
+                scores = jax.lax.dot_general(
+                    w_q, items, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
             scores = jnp.where(found[:, None], scores,
                                jnp.finfo(jnp.float32).min)
             top_v, top_i = jax.lax.top_k(scores, k)

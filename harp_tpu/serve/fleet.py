@@ -107,17 +107,21 @@ def build_endpoint(session, name: str, mspec: dict, *, version: int = 0,
     (:meth:`TopKEndpoint.restore_full`) at epoch ``version`` — the
     serving-grade recovery primitive, exercised for real."""
     kind = mspec.get("kind")
+    # resident quant mode rides the SPEC (ISSUE 17): every process that
+    # builds this model — initial worker, spare, artifact warmer — agrees
+    # on the mode, and the spec hash (= the AOT model_hash) changes with it
+    quant = mspec.get("quant")
     if kind == "topk":
         from harp_tpu.serve.endpoints import TopKEndpoint
 
         uf, items = topk_factors(mspec, version)
         if restore:
             ep = TopKEndpoint(session, name, np.zeros_like(uf), items,
-                              k=int(mspec.get("k", 10)))
+                              k=int(mspec.get("k", 10)), quant=quant)
             ep.restore_full(uf, version=version)
         else:
             ep = TopKEndpoint(session, name, uf, items,
-                              k=int(mspec.get("k", 10)))
+                              k=int(mspec.get("k", 10)), quant=quant)
             ep.version = int(version)
         return ep
     if kind == "classify_nn":
@@ -130,7 +134,7 @@ def build_endpoint(session, name: str, mspec: dict, *, version: int = 0,
         model.params = nn.init_params(
             (int(mspec["dim"]),) + layers + (int(mspec["classes"]),),
             seed=int(mspec.get("seed", 0)))
-        return classify_from_nn(session, model, name=name)
+        return classify_from_nn(session, model, name=name, quant=quant)
     raise ValueError(f"unknown model-spec kind {kind!r} for {name!r}")
 
 

@@ -44,6 +44,22 @@ hold — a reordered stale frame can never roll the gang's routing back.
 failed asks any surviving worker for the current map instead of waiting to
 be found. Both ride the same authenticated transport as requests.
 
+Compact reply encoding (ISSUE 17): a request may carry
+``"accept_enc": ["f16"]`` (or ``["int8"]``) — the client's declaration
+that it decodes encoded score payloads. The worker then replaces a top-k
+result's ``"scores"`` f32 list with a ``"scores_enc"`` tag::
+
+    {"v": 1, "dtype": "f16"|"int8", "n": k, "data": <raw bytes>,
+     "scale": <f32, int8 only>}
+
+shrinking the reply hop's score payload 2x (f16) or ~4x (int8 + one
+scale). The negotiation is strictly REQUEST-side: a client that never
+sends ``accept_enc`` (every pre-r17 client) receives plain f32
+``"scores"`` forever, and :func:`decode_result` is idempotent so a new
+client can decode any reply shape. Cache fills store the UNencoded
+result — encoding happens per-requester at the reply boundary, so one
+cached entry serves old and new clients alike.
+
 A SAMPLED request additionally carries a ``"trace"`` dict
 (:mod:`harp_tpu.telemetry.spans`): per-stage wall-clock stamps appended at
 every host boundary the frame crosses, returned on the reply so the client
@@ -69,6 +85,11 @@ CONTROL = "serve.control"
 
 OP_TOPK = "topk"
 OP_CLASSIFY = "classify"
+
+# reply score encodings a worker can produce (request-side negotiated via
+# "accept_enc"; ISSUE 17 compact reply wire)
+ENC_MODES = ("f16", "int8")
+ENC_VERSION = 1
 
 # error strings (reply["error"] leads with one of these)
 ERR_SHUTTING_DOWN = "shutting-down"
@@ -99,19 +120,99 @@ class ServeError(RuntimeError):
 def make_request(req_id: str, op: str, model: str, data: Any,
                  reply_to: Tuple[int, str, int],
                  deadline_ts: Optional[float] = None,
-                 priority: int = 0) -> dict:
+                 priority: int = 0,
+                 accept_enc: Optional[Tuple[str, ...]] = None) -> dict:
     """``priority`` (ISSUE 16): the load-shedding tier — anything >= the
     worker's ``brownout_min_priority`` keeps being served while a burning
     SLO watchdog sheds the rest. The worker default (0) sheds nothing at
     default priority: brownout is opt-in, by raising the threshold or by
-    submitting declared-droppable (negative-priority) traffic."""
+    submitting declared-droppable (negative-priority) traffic.
+
+    ``accept_enc`` (ISSUE 17): reply score encodings this client decodes,
+    in preference order (subset of :data:`ENC_MODES`). Omitted = the
+    pre-r17 contract, plain f32 scores."""
     if op not in (OP_TOPK, OP_CLASSIFY):
         raise ValueError(f"op must be {OP_TOPK!r} or {OP_CLASSIFY!r}, "
                          f"got {op!r}")
-    return {"kind": REQUEST, "id": req_id, "op": op, "model": model,
-            "data": data, "reply_to": tuple(reply_to),
-            "ts": time.time(), "deadline_ts": deadline_ts,
-            "priority": int(priority)}
+    req = {"kind": REQUEST, "id": req_id, "op": op, "model": model,
+           "data": data, "reply_to": tuple(reply_to),
+           "ts": time.time(), "deadline_ts": deadline_ts,
+           "priority": int(priority)}
+    if accept_enc:
+        bad = [e for e in accept_enc if e not in ENC_MODES]
+        if bad:
+            raise ValueError(f"accept_enc must be drawn from {ENC_MODES}, "
+                             f"got {bad}")
+        req["accept_enc"] = tuple(accept_enc)
+    return req
+
+
+def choose_enc(accept) -> Optional[str]:
+    """The encoding a worker uses for one reply: the requester's FIRST
+    advertised mode this worker supports, None when the request carries no
+    (usable) ``accept_enc`` — version skew degrades to f32, never to an
+    undecodable reply."""
+    if not accept:
+        return None
+    try:
+        for enc in accept:
+            if enc in ENC_MODES:
+                return enc
+    except TypeError:
+        return None
+    return None
+
+
+def encode_result(result: Any, enc: str) -> Any:
+    """A top-k result dict with its ``"scores"`` f32 list replaced by the
+    ``"scores_enc"`` tag (module docstring). Results without a scores list
+    (classify labels, not-found rows already pass through — an empty
+    scores list encodes to an empty payload) are returned unchanged."""
+    if enc not in ENC_MODES:
+        raise ValueError(f"enc must be one of {ENC_MODES}, got {enc!r}")
+    if not isinstance(result, dict) or "scores" not in result:
+        return result
+    import numpy as np
+
+    scores = np.asarray(result["scores"], np.float32)
+    out = {k: v for k, v in result.items() if k != "scores"}
+    tag = {"v": ENC_VERSION, "dtype": enc, "n": int(scores.size)}
+    if enc == "f16":
+        tag["data"] = scores.astype(np.float16).tobytes()
+    else:
+        peak = float(np.max(np.abs(scores))) if scores.size else 0.0
+        scale = (peak / 127.0) or 1.0    # all-zero scores: exact either way
+        tag["data"] = np.clip(np.rint(scores / scale), -127,
+                              127).astype(np.int8).tobytes()
+        tag["scale"] = scale
+    out["scores_enc"] = tag
+    return out
+
+
+def decode_result(result: Any) -> Any:
+    """Inverse of :func:`encode_result`; IDEMPOTENT — a plain-f32 result
+    (an old worker, a classify label, an error reply's None) passes
+    through untouched, so every client can run every reply through this."""
+    if not isinstance(result, dict):
+        return result
+    tag = result.get("scores_enc")
+    if tag is None:
+        return result
+    import numpy as np
+
+    dtype, n = tag.get("dtype"), int(tag.get("n", 0))
+    buf = tag.get("data", b"")
+    if dtype == "f16":
+        scores = np.frombuffer(buf, np.float16, count=n).astype(np.float32)
+    elif dtype == "int8":
+        scores = (np.frombuffer(buf, np.int8, count=n).astype(np.float32)
+                  * float(tag.get("scale", 1.0)))
+    else:
+        raise ServeError(f"unknown reply score encoding {dtype!r} "
+                         f"(this client decodes {ENC_MODES})")
+    out = {k: v for k, v in result.items() if k != "scores_enc"}
+    out["scores"] = [float(s) for s in scores]
+    return out
 
 
 def make_reply(request: dict, ok: bool, result: Any = None,

@@ -299,7 +299,8 @@ class ServeWorker:
             if ep is not None and getattr(ep, "op", None) == \
                     protocol.OP_TOPK:
                 version = getattr(ep, "version", None)
-                hit = self.cache.get(model, msg.get("data"), version)
+                hit = self.cache.get(model, msg.get("data"), version,
+                                     quant=getattr(ep, "quant", None))
             elif ep is None:
                 hit_v = self.cache.get_latest(model, msg.get("data"))
                 hit, version = hit_v if hit_v is not None else (None,
@@ -458,8 +459,12 @@ class ServeWorker:
                     and msg.get("op") == protocol.OP_TOPK):
                 # fill AT the reply boundary: the result was computed under
                 # exactly `version` (snapshotted with the dispatch state)
+                # and under the serving endpoint's quant mode — both join
+                # the key, and the stored result stays UNencoded so one
+                # entry serves old (f32) and new (accept_enc) clients
+                ep = self.endpoints.get(msg.get("model"))
                 self.cache.put(msg.get("model"), msg.get("data"), version,
-                               result)
+                               result, quant=getattr(ep, "quant", None))
             self._reply(msg, ok=ok, result=result, error=error, batch=batch,
                         bucket=bucket, version=version,
                         retry_after_s=retry_after_s)
@@ -468,6 +473,17 @@ class ServeWorker:
     def _reply(self, msg: dict, ok: bool, result=None, error=None,
                batch=None, bucket=None, version=None,
                retry_after_s=None) -> None:
+        if ok and result is not None:
+            # compact reply wire (ISSUE 17): encode the score payload iff
+            # THIS requester advertised it decodes the format — encoding
+            # at the single reply exit covers the dispatch path and the
+            # hot-key cache fast path alike, and a request without
+            # accept_enc (every pre-r17 client) gets plain f32 forever
+            enc = protocol.choose_enc(msg.get("accept_enc"))
+            if enc is not None:
+                result = protocol.encode_result(result, enc)
+                if isinstance(result, dict) and "scores_enc" in result:
+                    self.metrics.count(f"serve.reply_encoded.{enc}")
         if self.slo is not None:
             # one (age, ok) sample per reply: age = now − the client's
             # submit wall, i.e. end-to-end minus the reply hop — the
@@ -622,7 +638,10 @@ class _PendingReply:
             # retry_after_s off a shed reply without re-parsing the string
             err.reply = self.reply
             raise err
-        return self.reply["result"]
+        # idempotent: an encoded scores_enc payload (this client asked for
+        # it via accept_enc) decodes back to f32 scores; every other reply
+        # shape passes through untouched
+        return protocol.decode_result(self.reply["result"])
 
 
 class RouterClient:
@@ -633,12 +652,17 @@ class RouterClient:
                  secret: Optional[bytes] = None, host: str = "127.0.0.1",
                  metrics=None, trace_sample: Optional[int] = None,
                  span_metrics=None, breaker_threshold: int = 3,
-                 breaker_cooldown_s: float = 1.0):
+                 breaker_cooldown_s: float = 1.0,
+                 accept_enc: Optional[Tuple[str, ...]] = None):
         if metrics is None:
             from harp_tpu.utils.metrics import DEFAULT as metrics
         self.rank = rank
         self.placement = dict(placement)
         self.metrics = metrics
+        # compact replies (ISSUE 17): the encodings this client advertises
+        # on every request (None = the pre-r17 plain-f32 contract). Replies
+        # decode transparently in the future's result() either way.
+        self.accept_enc = tuple(accept_enc) if accept_enc else None
         # request tracing (telemetry.spans): sample every Nth submit; None
         # reads HARP_TRACE_REQUESTS (0/unset = off). span_metrics is where
         # the per-stage timers land — defaults to this client's registry,
@@ -1051,7 +1075,8 @@ class RouterClient:
         msg = protocol.make_request(
             rid, op, model, data,
             reply_to=(self.rank,) + tuple(self.transport.address),
-            deadline_ts=deadline_ts, priority=priority)
+            deadline_ts=deadline_ts, priority=priority,
+            accept_enc=self.accept_enc)
         if self.trace_sample and n % self.trace_sample == 0:
             spans.start_trace(msg, op=op, model=model)
 
@@ -1112,7 +1137,8 @@ def local_gang(session, worker_endpoints: List[Dict[str, object]], *,
                compile_cache_dir: Optional[str] = None,
                max_queue: Optional[int] = None,
                brownout_min_priority: int = 0,
-               client_rank_base: Optional[int] = None
+               client_rank_base: Optional[int] = None,
+               accept_enc: Optional[Tuple[str, ...]] = None
                ) -> Tuple[List[ServeWorker], Callable[..., RouterClient]]:
     """An in-process serving gang on loopback (the tier-1/bench topology;
     multi-host gangs pass explicit peer maps or KV rendezvous instead).
@@ -1140,6 +1166,10 @@ def local_gang(session, worker_endpoints: List[Dict[str, object]], *,
     ranks past the gang too; pass a high base (e.g. the process fleet's
     1000) so a scaled-up worker's rank can never collide with a client's
     and trip the reply-rank-collision guard.
+
+    ``accept_enc`` (ISSUE 17): score encodings every minted client
+    advertises (e.g. ``("f16",)``) — compact replies, decoded
+    transparently; None keeps the plain-f32 reply wire.
     """
     from harp_tpu.telemetry.watchdog import SLOWatchdog
 
@@ -1176,6 +1206,7 @@ def local_gang(session, worker_endpoints: List[Dict[str, object]], *,
                             metrics=(metrics_override if metrics_override
                                      is not None else metrics),
                             trace_sample=trace_sample,
-                            span_metrics=span_metrics)
+                            span_metrics=span_metrics,
+                            accept_enc=accept_enc)
 
     return workers, make_client

@@ -39,8 +39,9 @@ The process:
    replaced peers get dialed;
 5. serves until the controller drops the ``stop`` file, then drains
    cleanly, writes a final ``w<rank>.g<generation>.status.json`` (per-
-   model ``trace_counts``, artifact-loaded buckets, requests served — the
-   zero-recompile assertions read THIS, from outside the corpse), and
+   model ``trace_counts``, artifact-loaded buckets, requests served, and
+   per-model resident bytes + quant mode — the zero-recompile and the
+   int8-residency assertions read THIS, from outside the corpse), and
    exits 0.
 """
 
@@ -211,6 +212,13 @@ def main(argv=None) -> int:
             "trace_counts": {m: {str(b): int(n) for b, n
                                  in ep.trace_counts.items()}
                              for m, ep in endpoints.items()},
+            # resident footprint per model (ISSUE 17): the int8-vs-f32
+            # memory claim is asserted from OUTSIDE the corpse, like the
+            # zero-recompile one above
+            "resident_bytes": {m: int(ep.resident_bytes())
+                               for m, ep in endpoints.items()},
+            "quant": {m: getattr(ep, "quant", None)
+                      for m, ep in endpoints.items()},
             "requests": int(worker.metrics.snapshot()["counters"].get(
                 "serve.requests", 0)),
         }

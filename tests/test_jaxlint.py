@@ -286,6 +286,13 @@ def test_traced_budgets_match_committed_manifest(session):
     assert 0 < int8_bytes < f32_bytes / 2, (int8_bytes, f32_bytes)
     assert sum(traced["sgd_mf_dense_int8"][2].values()) < sum(
         traced["sgd_mf_dense"][2].values())
+    # the quantized SERVING wire (ISSUE 17): same route/route-back shape
+    # (3 all_to_all + 1 psum), strictly fewer bytes than the f32 dispatch
+    # — an endpoint silently reverting to f32 payloads fails JL203 here
+    serve_counts, _, serve_f32 = traced["serve_topk_mf"]
+    serve_counts_i8, _, serve_i8 = traced["serve_topk_mf_int8"]
+    assert serve_counts_i8 == serve_counts
+    assert 0 < sum(serve_i8.values()) < sum(serve_f32.values())
     assert sum(nbytes.values()) > 0
 
 

@@ -1,0 +1,348 @@
+"""Quantized serving path (ISSUE 17).
+
+Covers the packed-row int8 codec (host encode -> device decode, the
+all-zero-row corner), top-k answer parity vs the f32 endpoint at the
+recsys bench shapes under BOTH scoring policies, classify label parity
+with int8 resident params, the unknown-id and reshard-engine contracts
+under int8 state, the pinned int8 dispatch-wire budget (a doctored f32
+revert fails JL203), quant as a cache-key and AOT-key axis (stale-mode
+hits / silent installs are impossible), the resident-bytes gauge, and the
+compact reply wire (request-side negotiation, idempotent client decode,
+old clients keep plain f32).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from harp_tpu.collectives import quantize
+from harp_tpu.serve import (OP_CLASSIFY, OP_TOPK, TopKEndpoint,
+                            classify_from_nn, local_gang)
+from harp_tpu.serve import protocol
+from harp_tpu.serve.cache import TopKReplyCache
+from harp_tpu.utils.metrics import Metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _nn_model(session, dim=12, classes=3, seed=0):
+    from harp_tpu.models import nn
+
+    model = nn.MLPClassifier(session, nn.NNConfig(layers=(8,),
+                                                  num_classes=classes))
+    model.params = nn.init_params((dim, 8, classes), seed=seed)
+    return model
+
+
+def _factors(rng, users=64, items=32, rank=8):
+    uf = rng.normal(size=(users, rank)).astype(np.float32)
+    it = rng.normal(size=(items, rank)).astype(np.float32)
+    return uf, it
+
+
+def _overlap(a, b):
+    k = max(len(a), len(b))
+    return len(set(a) & set(b)) / k if k else 1.0
+
+
+# --------------------------------------------------------------------------- #
+# Packed-row codec
+# --------------------------------------------------------------------------- #
+
+def test_packed_row_codec_roundtrip_and_zero_row(session, rng):
+    import jax.numpy as jnp
+
+    rows = rng.normal(size=(17, 8)).astype(np.float32) * 3.0
+    rows[5] = 0.0                       # the all-zero corner
+    packed = quantize.encode_rows_np(rows)
+    assert packed.dtype == np.int8
+    assert packed.shape == (17, quantize.packed_row_width(8))
+    q, scales = quantize.decode_rows(jnp.asarray(packed))
+    deq = np.asarray(q, np.float32) * np.asarray(scales)[:, None]
+    # per-row absmax scaling: error bounded by scale/2 = max|row|/254
+    bound = np.abs(rows).max(axis=1, keepdims=True) / 254.0 + 1e-7
+    assert (np.abs(deq - rows) <= bound).all()
+    # the zero row decodes to EXACT +0.0 (its scale is 0.0, q * 0 = +0.0)
+    assert np.asarray(scales)[5] == 0.0
+    np.testing.assert_array_equal(deq[5], np.zeros(8, np.float32))
+    # dequantize_rows is the fused device twin of (decode, multiply)
+    fused = np.asarray(quantize.dequantize_rows(jnp.asarray(packed)))
+    np.testing.assert_allclose(fused, deq, rtol=0, atol=0)
+
+
+# --------------------------------------------------------------------------- #
+# Top-k parity at the recsys bench shapes, both scoring policies
+# --------------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("quant_score", ["int8_direct", "dequant"])
+def test_topk_int8_overlap_at_bench_shapes(session, rng, quant_score):
+    uf, items = _factors(rng, users=512, items=256, rank=8)
+    k = 10
+    ep32 = TopKEndpoint(session, f"mf32-{quant_score}", uf, items, k=k)
+    ep8 = TopKEndpoint(session, f"mf8-{quant_score}", uf, items, k=k,
+                       quant="int8", quant_score=quant_score)
+    ids = rng.choice(512, size=64, replace=False)
+    r32 = ep32.dispatch(ids)
+    r8 = ep8.dispatch(ids)
+    overlaps = [_overlap(a["items"], b["items"]) for a, b in zip(r32, r8)]
+    assert float(np.mean(overlaps)) >= 0.95, (quant_score, overlaps)
+    # int8 shrinks the resident store (the >= 3x bar is asserted at the
+    # bench's rank-64 shapes below — at rank 8 the +4 B/row scale and the
+    # id/count side-structures dilute the table term)
+    assert ep32.resident_bytes() / ep8.resident_bytes() >= 2.0
+
+
+def test_topk_int8_resident_reduction_at_rank64(session, rng):
+    # the bench-row acceptance shape term: at rank 64 the packed row is
+    # 68 int8 bytes vs 256 f32 bytes, so the endpoint footprint drops
+    # >= 3x even with the id/count side-structures included
+    uf, items = _factors(rng, users=64, items=32, rank=64)
+    ep32 = TopKEndpoint(session, "mf32r64", uf, items, k=5)
+    ep8 = TopKEndpoint(session, "mf8r64", uf, items, k=5, quant="int8")
+    assert ep32.resident_bytes() / ep8.resident_bytes() >= 3.0
+    assert [r["items"] for r in ep32.dispatch(np.arange(8))] == [
+        r["items"] for r in ep8.dispatch(np.arange(8))]
+
+
+def test_topk_int8_unknown_id_and_bad_quant(session, rng):
+    uf, items = _factors(rng)
+    ep = TopKEndpoint(session, "mf8u", uf, items, k=3, quant="int8")
+    rows = ep.dispatch(np.array([1, 999]))
+    assert rows[0]["found"] is True and len(rows[0]["items"]) == 3
+    assert rows[1] == {"found": False, "items": [], "scores": []}
+    with pytest.raises(ValueError, match="quant"):
+        TopKEndpoint(session, "bad", uf, items, k=3, quant="int4")
+    with pytest.raises(ValueError, match="quant_score"):
+        TopKEndpoint(session, "bad2", uf, items, k=3, quant="int8",
+                     quant_score="magic")
+
+
+# --------------------------------------------------------------------------- #
+# Classify parity with int8 resident params
+# --------------------------------------------------------------------------- #
+
+def test_classify_int8_label_parity(session, rng):
+    nn_model = _nn_model(session)
+    ep32 = classify_from_nn(session, nn_model, name="nnq32")
+    ep8 = classify_from_nn(session, nn_model, name="nnq8", quant="int8")
+    x = rng.normal(size=(48, 12)).astype(np.float32)
+    got32, got8 = ep32.dispatch(x), ep8.dispatch(x)
+    agree = np.mean(np.asarray(got32) == np.asarray(got8))
+    assert agree >= 0.95, (agree, got32, got8)
+    assert ep32.resident_bytes() / ep8.resident_bytes() >= 3.0
+
+
+# --------------------------------------------------------------------------- #
+# Reshard engine under int8 state (packed rows ride the same moves)
+# --------------------------------------------------------------------------- #
+
+def test_int8_restore_shard_and_rebalance_keep_answers(session, rng):
+    uf, items = _factors(rng)
+    ep = TopKEndpoint(session, "mf8rs", uf, items, k=4, quant="int8")
+    ids = np.arange(0, 64, 3)
+    baseline = ep.dispatch(ids[:8])
+    # wipe rank 2's shard, restore it through the reshard engine
+    keys_d, vals_d, counts_d, items_d = ep._state[:4]
+    wiped = np.asarray(vals_d).copy()
+    wiped[2] = 0
+    ep._state = (keys_d, ep.session.scatter(wiped), counts_d, items_d)
+    assert ep.dispatch(ids[:8]) != baseline
+    n = ep.restore_shard(2, uf)
+    assert n == int(np.sum(np.arange(64) % 8 == 2))
+    assert ep.dispatch(ids[:8]) == baseline
+    # rebalance away from rank 1: same answers, unknown ids still clean
+    info = ep.rebalance(1)
+    assert info["owners"][1] == 0
+    assert ep.dispatch(ids[:8]) == baseline
+    assert ep.dispatch(np.array([999]))[0]["found"] is False
+
+
+def test_int8_push_epoch_swaps_answers(session, rng):
+    uf, items = _factors(rng)
+    ep = TopKEndpoint(session, "mf8pe", uf, items, k=3, quant="int8")
+    before = ep.dispatch(np.arange(8))
+    rng2 = np.random.default_rng(99)
+    uf2 = rng2.normal(size=uf.shape).astype(np.float32) * 2.0
+    it2 = rng2.normal(size=items.shape).astype(np.float32) * 2.0
+    ep.push_epoch(uf2, it2, version=1)
+    after = ep.dispatch(np.arange(8))
+    assert after != before
+    # the swapped epoch answers match a fresh int8 endpoint on uf2/it2
+    fresh = TopKEndpoint(session, "mf8pe2", uf2, it2, k=3, quant="int8")
+    assert [r["items"] for r in after] == [
+        r["items"] for r in fresh.dispatch(np.arange(8))]
+
+
+# --------------------------------------------------------------------------- #
+# The pinned int8 wire: strictly below f32, doctored revert is loud
+# --------------------------------------------------------------------------- #
+
+def test_int8_budget_row_pinned_below_f32():
+    from tools.jaxlint import checkers_jaxpr
+
+    with open(os.path.join(REPO, checkers_jaxpr.BUDGET_FILE)) as f:
+        manifest = json.load(f)
+    f32 = manifest["targets"]["serve_topk_mf"]
+    i8 = manifest["targets"]["serve_topk_mf_int8"]
+    assert i8["collectives"] == f32["collectives"]
+    assert 0 < i8["bytes_per_step"] < f32["bytes_per_step"]
+
+
+def test_doctored_f32_revert_fails_jl203():
+    # the silent-revert signature: the int8 target tracing at the f32
+    # row's bytes — same counts, wider wire — must fail JL203
+    from tools.jaxlint import checkers_jaxpr
+
+    with open(os.path.join(REPO, checkers_jaxpr.BUDGET_FILE)) as f:
+        manifest = json.load(f)
+    f32 = manifest["targets"]["serve_topk_mf"]
+    i8 = manifest["targets"]["serve_topk_mf_int8"]
+    doctored = {"serve_topk_mf_int8": (
+        dict(i8["collectives"]), [], dict(f32["bytes_by_kind"]))}
+    findings = checkers_jaxpr.check_budget(REPO, doctored)
+    hits = [f for f in findings if f.code == "JL203"
+            and f.func == "serve_topk_mf_int8"]
+    assert hits, findings
+    # the honest bytes pass the same gate
+    clean = {"serve_topk_mf_int8": (
+        dict(i8["collectives"]), [], dict(i8["bytes_by_kind"]))}
+    assert not any(f.func == "serve_topk_mf_int8"
+                   for f in checkers_jaxpr.check_budget(REPO, clean))
+
+
+# --------------------------------------------------------------------------- #
+# Quant as a key axis: reply cache and AOT store
+# --------------------------------------------------------------------------- #
+
+def test_cache_keys_on_quant_mode():
+    cache = TopKReplyCache(metrics=Metrics())
+    cache.put("mf", 7, 0, {"items": [1]}, quant=None)        # f32 fill
+    assert cache.get("mf", 7, 0, quant=None) == {"items": [1]}
+    # the int8 twin at the SAME epoch can never see the f32 entry...
+    assert cache.get("mf", 7, 0, quant="int8") is None
+    # ...and an int8 fill flips latest, retiring the f32 mode's entries
+    cache.put("mf", 7, 0, {"items": [2]}, quant="int8")
+    assert cache.get_latest("mf", 7) == ({"items": [2]}, 0)
+
+
+def test_aot_f32_artifact_is_loud_miss_for_int8_endpoint(session, rng,
+                                                         tmp_path):
+    from harp_tpu.aot import serve_artifacts
+    from harp_tpu.aot.store import ArtifactStore
+
+    m = Metrics()
+    store = ArtifactStore(str(tmp_path / "store"), metrics=m)
+    uf, items = _factors(rng, users=48, items=24, rank=6)
+    donor = TopKEndpoint(session, "mfq", uf, items, k=3, bucket_sizes=(8,))
+    serve_artifacts.export_endpoint(store, donor, model_hash="h")
+    twin = TopKEndpoint(session, "mfq", uf, items, k=3, bucket_sizes=(8,),
+                        quant="int8")
+    loaded = serve_artifacts.load_endpoint(store, twin, model_hash="h",
+                                           warm=False)
+    # NEVER a silent install: the f32-keyed artifact misses on the quant
+    # axis and the miss is metered
+    assert loaded == []
+    assert m.snapshot()["counters"].get("aot.store.miss_quant", 0) >= 1
+    # the int8 endpoint's own export round-trips for its int8 twin
+    serve_artifacts.export_endpoint(store, twin, model_hash="h")
+    twin2 = TopKEndpoint(session, "mfq", uf, items, k=3, bucket_sizes=(8,),
+                         quant="int8")
+    assert serve_artifacts.load_endpoint(store, twin2, model_hash="h",
+                                         warm=False) == [8]
+
+
+# --------------------------------------------------------------------------- #
+# Resident-bytes gauge
+# --------------------------------------------------------------------------- #
+
+def test_resident_bytes_gauge_exported(session, rng):
+    m = Metrics()
+    uf, items = _factors(rng)
+    ep = TopKEndpoint(session, "mfg", uf, items, k=3, quant="int8",
+                      metrics=m)
+    gauges = m.snapshot()["gauges"]
+    assert gauges["serve.resident_bytes.mfg"] == ep.resident_bytes()
+    # the gauge tracks epoch swaps (re-published, same packed footprint)
+    ep.push_epoch(uf * 2.0, items, version=1)
+    assert (m.snapshot()["gauges"]["serve.resident_bytes.mfg"]
+            == ep.resident_bytes())
+
+
+# --------------------------------------------------------------------------- #
+# Compact reply wire
+# --------------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("enc", ["f16", "int8"])
+def test_reply_encode_decode_roundtrip(enc):
+    result = {"found": True, "items": [3, 1, 2],
+              "scores": [1.5, -0.25, 0.125]}
+    wire = protocol.encode_result(result, enc)
+    assert "scores" not in wire and wire["scores_enc"]["dtype"] == enc
+    assert wire["items"] == [3, 1, 2]
+    back = protocol.decode_result(wire)
+    tol = 1e-3 if enc == "f16" else 1.5 / 127.0
+    np.testing.assert_allclose(back["scores"], result["scores"], atol=tol)
+    # idempotent on both shapes: plain results and already-decoded ones
+    assert protocol.decode_result(back) == back
+    assert protocol.decode_result(result) == result
+    assert protocol.decode_result(None) is None
+    # non-score results (classify labels) pass through untouched
+    assert protocol.encode_result(2, enc) == 2
+    # empty scores encode to an empty payload and decode back
+    empty = protocol.decode_result(protocol.encode_result(
+        {"found": False, "items": [], "scores": []}, enc))
+    assert empty["scores"] == []
+
+
+def test_choose_enc_negotiation():
+    assert protocol.choose_enc(None) is None
+    assert protocol.choose_enc(()) is None
+    assert protocol.choose_enc(("f16",)) == "f16"
+    assert protocol.choose_enc(("int8", "f16")) == "int8"
+    # unknown-first degrades to the first mode this worker supports
+    assert protocol.choose_enc(("zstd9", "f16")) == "f16"
+    assert protocol.choose_enc(("zstd9",)) is None
+    assert protocol.choose_enc(7) is None
+    with pytest.raises(ValueError, match="accept_enc"):
+        protocol.make_request("r0", OP_TOPK, "mf", 1, (0, "h", 1),
+                              accept_enc=("zstd9",))
+
+
+def test_gang_encoded_replies_old_and_new_clients(session, rng):
+    """End to end through the quantized gang: a new client (accept_enc)
+    receives encoded scores and decodes them transparently; an old client
+    (no accept_enc) keeps receiving plain f32 — same answers."""
+    uf, items = _factors(rng)
+    ep = TopKEndpoint(session, "mfe", uf, items, k=3, quant="int8")
+    m = Metrics()
+    workers, make_client = local_gang(session, [{"mfe": ep}], metrics=m,
+                                      accept_enc=("f16",))
+    new_c = make_client()
+    try:
+        res_new = new_c.request(OP_TOPK, "mfe", 5, timeout=60.0)
+        assert res_new["found"] is True and len(res_new["scores"]) == 3
+        assert all(isinstance(s, float) for s in res_new["scores"])
+        # the worker really did encode (the counter is the proof — the
+        # client-side decode makes the payload shape invisible up here)
+        assert m.snapshot()["counters"].get(
+            "serve.reply_encoded.f16", 0) >= 1
+    finally:
+        new_c.close()
+        for w in workers:
+            w.close()
+    # old-client path: a fresh f32-contract gang on the same endpoint
+    # state answers with IDENTICAL items and compatible scores
+    ep2 = TopKEndpoint(session, "mfe2", uf, items, k=3, quant="int8")
+    workers2, make_client2 = local_gang(session, [{"mfe2": ep2}])
+    old = make_client2()
+    try:
+        res_old = old.request(OP_TOPK, "mfe2", 5, timeout=60.0)
+        assert res_old["items"] == res_new["items"]
+        np.testing.assert_allclose(res_old["scores"], res_new["scores"],
+                                   atol=1e-2)
+    finally:
+        old.close()
+        for w in workers2:
+            w.close()

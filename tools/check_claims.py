@@ -301,6 +301,36 @@ CLAIMS: List[Claim] = [
           r"Serve top-k lookup \(serve_topk_mf\) \| (\S+) B",
           ("targets", "serve_topk_mf", "bytes_per_step"),
           rel_tol=0.0, file="tools/collective_budget.json"),
+    # PERF.md r18 + README "Quantized serving" (ISSUE 17): the int8
+    # dispatch wire pinned against the traced manifest (exact — a silent
+    # f32 revert moves the manifest and fails jaxlint first, this table
+    # second), and the committed serving_quant row's headline pair: the
+    # resident-footprint reduction (deterministic byte counts, tight
+    # band) and the sampled top-k overlap vs the f32 gang.
+    Claim("comm_serve_topk_int8", "PERF.md",
+          r"Serve top-k lookup, int8 \(serve_topk_mf_int8\) \| (\S+) B",
+          ("targets", "serve_topk_mf_int8", "bytes_per_step"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("quant_topk_reduction", "PERF.md",
+          r"top-k table shrinks (\S+)×",
+          ("serving_quant", "resident_reduction", "topk"), rel_tol=0.01),
+    Claim("quant_topk_overlap", "PERF.md",
+          r"mean top-10 overlap (\S+)",
+          ("serving_quant", "topk_overlap", "mean"), rel_tol=0.05),
+    Claim("quant_f32_qps", "PERF.md",
+          r"\| f32 residents \| (\S+) \|",
+          ("serving_quant", "modes", "f32", "mixes", "topk_heavy", "qps"),
+          rel_tol=0.25),
+    Claim("quant_int8_qps", "PERF.md",
+          r"\| int8 residents \| (\S+) \|",
+          ("serving_quant", "modes", "int8", "mixes", "topk_heavy",
+           "qps"), rel_tol=0.25),
+    Claim("quant_topk_reduction_readme", "README.md",
+          r"resident\s+footprint is (\S+)× smaller",
+          ("serving_quant", "resident_reduction", "topk"), rel_tol=0.01),
+    Claim("quant_topk_overlap_readme", "README.md",
+          r"mean top-10 overlap\s+(\S+) against the f32 gang",
+          ("serving_quant", "topk_overlap", "mean"), rel_tol=0.05),
     # README "On-device resharding" + PERF.md r12 (ISSUE 11): the measured
     # CPU-mesh reshard row (the on-chip GB-scale re-measure rewrites the
     # record AND this prose, by design) plus the traced per-round byte pins

@@ -108,6 +108,19 @@
 #                     mid-storm, and fresh workers install untraced
 #                     (trace_counts 0) behind a versioned placement.
 #
+# r18 (ISSUE 17): stage 1's manifest additionally pins the QUANTIZED
+# serving dispatch — serve_topk_mf_int8 at the SAME 3 all_to_alls +
+# overflow psum as serve_topk_mf but 172 B/step vs 356 B (the packed
+# int8 rows ride the route/route-back wire): an int8 endpoint silently
+# reverting to f32 payloads re-widens the wire at unchanged counts,
+# which is exactly the JL203 byte-drift signature (tier-1 doctors one in
+# tests/test_serve_quant.py to prove the gate fires, and stage 4 pins
+# the same bytes — plus the committed serving_quant resident-reduction/
+# overlap row — into the PERF.md/README prose). The int8 scoring dot
+# accumulates in int32 via preferred_element_type, which the JL202 dtype
+# policy accepts by construction (it flags bf16-accumulating dots, not
+# integer dots).
+#
 # Any stage failing fails the script; all stages always run (a lint
 # finding must not hide a test regression or vice versa).
 

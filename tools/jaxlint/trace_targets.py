@@ -220,6 +220,24 @@ def _serve_topk_rebalanced():
     return fn, args
 
 
+def _serve_topk_int8():
+    """The QUANTIZED serving dispatch (ISSUE 17): same 3 all_to_alls +
+    1 psum as serve_topk_mf, but the route-back all_to_all carries packed
+    int8 factor rows (r+4 bytes/row instead of 4r f32 bytes) — the pinned
+    byte row sits strictly below the f32 twin's, so a silent f32 revert
+    grows bytes at the same counts and fails JL203."""
+    from harp_tpu.serve import endpoints as serve_ep
+
+    sess = _session()
+    rng = _rng()
+    uf = rng.normal(size=(64, 8)).astype("float32")
+    items = rng.normal(size=(32, 8)).astype("float32")
+    ep = serve_ep.TopKEndpoint(sess, "mf", uf, items, k=4, quant="int8")
+    ids = rng.integers(0, 64, size=ep.bucket_sizes[0])
+    fn, args, _n, _bucket = ep.prepared(ids)
+    return fn, args
+
+
 def _multiclass_svm_pairs():
     """The multiclass one-vs-one TRAINING program: all pair machines in one
     vmapped rotation-blocked kernel-dual program (KernelSVM.
@@ -409,6 +427,11 @@ TARGETS: Dict[str, Callable[[], Tuple[Callable, tuple]]] = {
     "reshard_factor_a2a": _reshard("alltoall"),
     "reshard_factor_ring": _reshard("ring"),
     "serve_topk_mf_rebalanced": _serve_topk_rebalanced,
+    # r17 (ISSUE 17): the int8 serving dispatch — the quantized twin of
+    # serve_topk_mf (same collective counts, packed int8 route-back), the
+    # budget row that makes a silent f32 revert on the REQUEST path as
+    # loud as one on a training path.
+    "serve_topk_mf_int8": _serve_topk_int8,
 }
 
 
