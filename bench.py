@@ -1114,6 +1114,25 @@ def tpu_reshard(small=False):
     return row
 
 
+def tpu_ingest(small=False):
+    """Streaming-ingestion rows (ISSUE 18 acceptance): GB-scale part-file
+    stream through the io/pipeline engine — load MB/s for the bounded-queue
+    drain, serialized vs prefetch-overlapped twin walls (overlap_efficiency,
+    gated >= 1.3x where overlap is physically available — see the row's
+    overlap_gate/overlap_note), end-to-end stream->assemble->Lloyd-fit wall,
+    the per-stage telemetry timer table, and the distributed COO->CSR
+    regroup on the jaxlint-pinned ingest_coo_regroup all_to_all schedule.
+    The host-side stages (read/parse/chunk) measure for real on any host;
+    the compute/H2D columns of a CPU-mesh row carry the usual on-chip
+    re-measure convention."""
+    from harp_tpu.benchmark import ingest as bench_ingest
+
+    if small:
+        return bench_ingest.bench_ingest(
+            total_mb=48, parts=6, chunk_rows=16384, fit_iters=2)
+    return bench_ingest.bench_ingest()
+
+
 def p2p_event_rtt_us(rounds=200):
     """Host event-plane round trip (send → wait_event → reply → wait): the
     latency the true P2P transport (authenticated, loopback) delivers.
@@ -1192,7 +1211,8 @@ ROW_GROUPS = ("kmeans", "kmeans_padded128", "kmeans_csr", "sgd_mf", "als",
               "nn_compute_bound", "attention", "attention_blocksparse",
               "kernel_svm", "mds", "sort", "csr_cov", "kmeans_from_files",
               "p2p", "mesh", "collectives_quantized", "telemetry_overhead",
-              "ring_dma_overlap", "serving", "serving_quant", "reshard")
+              "ring_dma_overlap", "serving", "serving_quant", "reshard",
+              "ingest")
 
 
 def main():
@@ -1707,6 +1727,36 @@ def main():
                 "reshard_host_vs_device_speedup":
                     cpu_mesh["host_vs_device_speedup"]})
 
+    if want("ingest"):
+        begin("ingest")
+        try:
+            irow = tpu_ingest(small)
+        except Exception as e:     # noqa: BLE001 — bench must not die here
+            irow = {"error": str(e)[:200]}
+        detail["ingest"] = irow
+        detail["bench_schema_note_r19"] = (
+            "r19 adds the ingest group (bench.py --only ingest): the "
+            "streaming ingestion engine (io/pipeline) at the ~1 GB "
+            "part-file size — stream_load_mb_per_sec for the full "
+            "bounded-queue drain, the serialized (prefetch-off) vs "
+            "overlapped twin walls with overlap_efficiency, the "
+            "end-to-end stream->assemble->fit wall, the per-stage timer "
+            "table (list/count/read/parse/chunk/regroup/h2d/compute), "
+            "and the distributed COO->CSR regroup row (device all_to_all "
+            "on the jaxlint-pinned ingest_coo_regroup budget schedule). "
+            "The overlap >= 1.3x acceptance gate applies where overlap "
+            "is physically available (overlap_gate='on': multi-core host "
+            "or accelerator compute); on this 1-core CPU host the twins "
+            "time-share one core, the measured ratio rides in the row "
+            "and the driver's on-chip run re-measures it — same "
+            "convention as the telemetry_overhead/ring_dma_overlap "
+            "rows.")
+        if isinstance(irow, dict) and "stream_load_mb_per_sec" in irow:
+            compact.update({
+                "ingest_load_mb_per_sec": irow["stream_load_mb_per_sec"],
+                "ingest_overlap_efficiency": irow["overlap_efficiency"],
+                "ingest_e2e_wall_s": irow["e2e_stream_fit_wall_s"]})
+
     detail["xeon_anchor_note"] = (
         f"vs_cpu = measured vs ONE modern Zen core (this host has 1 "
         f"core); vs_xeon36_lb = vs_cpu/{XEON_CORES}, a conservative "
@@ -1756,6 +1806,13 @@ def main():
                 f"bench: serving_quant contract FAILED (topk resident "
                 f"reduction {red}x < 3x or overlap {ovl} < 0.95)\n")
             sys.exit(1)
+    irow = detail.get("ingest")
+    if (isinstance(irow, dict) and irow.get("overlap_gate") == "on"
+            and irow.get("overlap_pass") is False):
+        sys.stderr.write(
+            f"bench: ingest overlap contract FAILED (efficiency "
+            f"{irow['overlap_efficiency']}x < 1.3x with overlap gate on)\n")
+        sys.exit(1)
 
 
 if __name__ == "__main__":

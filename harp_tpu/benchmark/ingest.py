@@ -1,0 +1,206 @@
+"""Ingestion-pipeline bench rows (ISSUE 18): load MB/s per stage, overlap
+efficiency of the prefetched stream vs its serialized twin, and end-to-end
+stream→fit wall at the ~1 GB part-file size.
+
+Twin discipline: both sides run the IDENTICAL per-chunk work (parse →
+fixed-budget slice → H2D placement → one compiled minibatch step).  The
+serialized twin (``StreamLoader(serial=True)`` + ``DevicePrefetcher``
+disabled) does it all sequentially on one thread; the overlapped twin runs
+the reader pool + H2D prefetch thread so chunk N+1's parse + transfer hides
+behind chunk N's compute.  ``overlap_efficiency = serial_wall /
+overlapped_wall`` — on a multi-core host (or with compute on a real
+accelerator) the stages genuinely overlap and the ratio clears 1.3x; on a
+single-core CPU host parse and compute time-share the one core, the ratio
+sits at ~1.0 by physics, and the committed row says so in its note (the
+same driver-refills convention as the telemetry_overhead / ring_dma rows).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import time
+from typing import Optional
+
+import numpy as np
+
+
+def _stage_table(metrics, csv_bytes: int) -> dict:
+    """Per-stage timing table: total seconds + an MB/s rate priced against
+    the stage's natural byte flow (CSV bytes for read/parse, f32 bytes for
+    chunk/H2D)."""
+    from harp_tpu.io import pipeline as pl
+
+    out = {}
+    for stage in pl.STAGES:
+        t = metrics.timing(stage)
+        if not t.get("count"):
+            continue
+        row = {"count": int(t["count"]), "total_s": round(t["total_s"], 4),
+               "mean_ms": round(t["mean_s"] * 1e3, 3)}
+        if stage in ("ingest.read", "ingest.parse", "ingest.count") \
+                and t["total_s"] > 0:
+            row["mb_per_s"] = round(csv_bytes / t["total_s"] / 1e6, 1)
+        out[stage.split(".", 1)[1]] = row
+    return out
+
+
+def bench_ingest(total_mb: int = 1024, d: int = 128, k: int = 64,
+                 parts: int = 16, chunk_rows: int = 65536,
+                 fit_iters: int = 2, num_threads: int = 4,
+                 queue_depth: int = 4,
+                 tmpdir: Optional[str] = None) -> dict:
+    """The ``--only ingest`` row.  Generates ~``total_mb`` MB of CSV
+    part-files (ONE template part is written with savetxt, then byte-copied
+    — savetxt at a full GB would dominate the bench), then measures:
+
+    * ``stream_load_mb_per_sec`` — full StreamLoader drain, no compute.
+    * ``serialized_wall_s`` / ``overlapped_wall_s`` / ``overlap_efficiency``
+      — the twin runs described in the module docstring.
+    * ``e2e_stream_fit_wall_s`` — StreamLoader → DevicePrefetcher →
+      ``KMeans.fit_from_stream`` (assembly + ``fit_iters`` Lloyd
+      iterations), the GB-scale flagship workflow end to end.
+    * ``regroup`` — the distributed COO→CSR path: device regroup wall, wire
+      bytes and rounds from the plan (the jaxlint-pinned budget schedule).
+    * ``stages`` — the per-stage telemetry-timer table.
+    """
+    import jax
+
+    from harp_tpu.io import datagen, loaders, pipeline as pl
+    from harp_tpu.models import kmeans as km
+    from harp_tpu.session import HarpSession
+    from harp_tpu.utils.metrics import Metrics
+
+    sess = HarpSession()
+    w = sess.num_workers
+    tmp = tmpdir or tempfile.mkdtemp(prefix="harp_bench_ingest_")
+    try:
+        # one template part of total_mb/parts MB, byte-copied to the rest:
+        # identical bytes per part, so rates are unaffected and generation
+        # stays seconds, not minutes
+        bytes_per_row = d * 9          # "%.6f" + sep ~ 9 B per value
+        rows_per_part = max(w, int(total_mb * 1e6 / parts / bytes_per_row))
+        template = os.path.join(tmp, "part-00000")
+        block = datagen.dense_points(rows_per_part, d, seed=418,
+                                     num_clusters=k)
+        np.savetxt(template, block, fmt="%.6f", delimiter=",")
+        for i in range(1, parts):
+            shutil.copyfile(template, os.path.join(tmp, f"part-{i:05d}"))
+
+        list_reg = Metrics()
+        with list_reg.timer("ingest.list"):
+            paths = loaders.list_files(tmp)
+        csv_bytes = sum(os.path.getsize(p) for p in paths)
+        total_rows = rows_per_part * parts
+        n_fit = total_rows - total_rows % w
+        cen0 = datagen.initial_centroids(block, k, seed=419)
+        cfg = km.KMeansConfig(k, d, fit_iters, "regroupallgather")
+        model = km.KMeans(sess, cfg)
+
+        def loader(serial=False, metrics=None):
+            return pl.StreamLoader(
+                paths, chunk_rows=chunk_rows, num_threads=num_threads,
+                queue_depth=queue_depth, serial=serial, count=False,
+                metrics=metrics)
+
+        # -- pure load rate: drain the stream, touch nothing downstream --
+        load_reg = Metrics()
+        t0 = time.perf_counter()
+        n_chunks = sum(1 for _ in loader(metrics=load_reg))
+        t_load = time.perf_counter() - t0
+
+        # warm the per-chunk compile before either twin is timed
+        warm = pl.DevicePrefetcher(loader(), sess.scatter, enabled=False)
+        model.fit_stream_minibatch([next(iter(warm))], cen0)
+
+        # -- serialized twin: parse -> H2D -> compute, one thread, no
+        # readahead (the prefetch-off wall) --
+        ser_reg = Metrics()
+        t0 = time.perf_counter()
+        model.fit_stream_minibatch(
+            pl.DevicePrefetcher(loader(serial=True, metrics=ser_reg),
+                                sess.scatter, enabled=False,
+                                metrics=ser_reg), cen0)
+        t_serial = time.perf_counter() - t0
+
+        # -- overlapped twin: reader pool + H2D prefetch thread --
+        ovl_reg = Metrics()
+        t0 = time.perf_counter()
+        model.fit_stream_minibatch(
+            pl.DevicePrefetcher(loader(metrics=ovl_reg), sess.scatter,
+                                metrics=ovl_reg), cen0)
+        t_overlap = time.perf_counter() - t0
+        efficiency = t_serial / t_overlap if t_overlap > 0 else 0.0
+
+        # -- end to end: stream -> assemble -> full Lloyd fit --
+        e2e_reg = Metrics()
+        t0 = time.perf_counter()
+        _, costs = model.fit_from_stream(
+            pl.DevicePrefetcher(loader(metrics=e2e_reg),
+                                sess.replicate_put, metrics=e2e_reg),
+            cen0, n_fit, metrics=e2e_reg)
+        np.asarray(costs)
+        t_e2e = time.perf_counter() - t0
+        pl.flush_stage_timings(e2e_reg, extra={"bench": "ingest"})
+
+        # -- distributed COO->CSR: device regroup on the pinned bounded
+        # all_to_all schedule + native counting sort per worker --
+        from harp_tpu.collectives import reshard as rs
+
+        rng = np.random.default_rng(420)
+        nnz, coo_rows = 200_000, 8192
+        crow = rng.integers(0, coo_rows, nnz).astype(np.int64)
+        ccol = rng.integers(0, 4096, nnz).astype(np.int64)
+        cval = rng.standard_normal(nnz).astype(np.float32)
+        plan, _, _ = rs.plan_coo_regroup(crow, coo_rows, w)
+        reg_reg = Metrics()
+        pl.coo_to_csr_distributed(sess, crow, ccol, cval,
+                                  num_rows=coo_rows, metrics=reg_reg)
+        t0 = time.perf_counter()
+        pl.coo_to_csr_distributed(sess, crow, ccol, cval,
+                                  num_rows=coo_rows, metrics=reg_reg)
+        t_regroup = time.perf_counter() - t0
+
+        cores = os.cpu_count() or 1
+        on_accel = any(dev.platform != "cpu" for dev in jax.devices())
+        gate = "on" if (cores >= 2 or on_accel) else "skipped"
+        stages = _stage_table(e2e_reg, csv_bytes)
+        stages.update(_stage_table(list_reg, csv_bytes))
+        return {
+            "config": (f"total_mb={total_mb} d={d} k={k} parts={parts} "
+                       f"chunk_rows={chunk_rows} fit_iters={fit_iters} "
+                       f"threads={num_threads} depth={queue_depth}"),
+            "csv_bytes": csv_bytes,
+            "total_rows": total_rows,
+            "chunks": n_chunks,
+            "stream_load_mb_per_sec": round(csv_bytes / t_load / 1e6, 1),
+            "serialized_wall_s": round(t_serial, 3),
+            "overlapped_wall_s": round(t_overlap, 3),
+            "overlap_efficiency": round(efficiency, 3),
+            "overlap_gate": gate,
+            "overlap_pass": (bool(efficiency >= 1.3) if gate == "on"
+                             else None),
+            "overlap_note": (
+                "parse + H2D of chunk N+1 hidden behind chunk N's compute; "
+                f"this host has {cores} CPU core(s) and "
+                f"{'an accelerator' if on_accel else 'no accelerator'} — "
+                "on a single-core CPU host the stages time-share one core "
+                "and the ratio is ~1.0 by physics; the >= 1.3x acceptance "
+                "gate applies where overlap is physically available "
+                "(multi-core or device compute; the driver's on-chip run "
+                "re-measures this row)"),
+            "e2e_stream_fit_wall_s": round(t_e2e, 3),
+            "stages": stages,
+            "regroup": {
+                "nnz": nnz,
+                "num_rows": coo_rows,
+                "wall_s": round(t_regroup, 4),
+                "wire_bytes": int(plan.bytes_moved),
+                "rounds": int(plan.rounds),
+                "records_mb_per_s": round(nnz * 20 / t_regroup / 1e6, 1),
+            },
+        }
+    finally:
+        if tmpdir is None:
+            shutil.rmtree(tmp, ignore_errors=True)

@@ -276,6 +276,43 @@ def plan_factor_reshard(old: RowLayout, old_world: int, new: RowLayout,
                       chunk_bytes, schedule)
 
 
+def plan_coo_regroup(rows: np.ndarray, num_rows: int, num_workers: int,
+                     row_bytes: int = 20,
+                     chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+                     schedule: str = "alltoall"
+                     ) -> Tuple[ReshardPlan, np.ndarray, int]:
+    """Plan routing COO nonzeros to the worker owning their row block — the
+    ingestion regroup (HarpDAALDataSource.regroupCOOList) as a bounded
+    reshard instead of a whole-table host shuffle.
+
+    Record i sits at flat source position i (parse order, contiguous split
+    over the mesh); its destination is ``owner * capacity + rank`` where
+    ``owner`` follows the SAME ceil-block ownership rule as the host oracle
+    (``loaders.regroup_coo_by_row``) and ``rank`` is the record's order
+    among its owner's records in GLOBAL parse order — so each worker's
+    received slice is exactly the oracle's boolean-mask slice, nnz for nnz.
+
+    ``row_bytes`` defaults to the packed (row i64, col i64, val f32) record:
+    5 int32 lanes = 20 B (io/pipeline.pack_coo).  Returns
+    ``(plan, per-worker counts, per-worker slot capacity)``.
+    """
+    rows = np.asarray(rows, np.int64)
+    n = len(rows)
+    w = int(num_workers)
+    block = -(-max(int(num_rows), 1) // w)
+    owner = np.minimum(rows // block, w - 1)
+    counts = np.bincount(owner, minlength=w).astype(np.int64)
+    cap = max(1, int(counts.max(initial=0)))
+    starts = np.concatenate([[0], np.cumsum(counts)])
+    order = np.argsort(owner, kind="stable")
+    rank = np.empty(n, np.int64)
+    rank[order] = np.arange(n) - starts[owner[order]]
+    dst_pos = owner * cap + rank
+    plan = plan_moves(np.arange(n, dtype=np.int64), dst_pos, max(n, 1),
+                      w * cap, w, row_bytes, chunk_bytes, schedule)
+    return plan, counts, cap
+
+
 # --------------------------------------------------------------------------- #
 # Device programs
 # --------------------------------------------------------------------------- #

@@ -75,7 +75,10 @@ def available() -> bool:
     return _find_lib() is not None
 
 
-def parse_csv(path: str, sep: str = ",") -> Optional[np.ndarray]:
+def count_csv(path: str, sep: str = ",") -> Optional[Tuple[int, int]]:
+    """(rows, cols) of a dense CSV from the native mmap counting pass — the
+    cheap first phase that lets loaders preallocate the full dataset once
+    and parse every part directly into its row-offset view."""
     lib = _find_lib()
     if lib is None:
         return None
@@ -85,31 +88,73 @@ def parse_csv(path: str, sep: str = ",") -> Optional[np.ndarray]:
                            ctypes.byref(rows), ctypes.byref(cols))
     if n < 0:
         return None
-    out = np.empty((rows.value, cols.value), dtype=np.float32)
+    return int(rows.value), int(cols.value)
+
+
+def parse_csv_into(path: str, out: np.ndarray, sep: str = ",") -> bool:
+    """Parse a dense CSV directly into a caller-owned f32 buffer (usually a
+    view into a preallocated dataset array). ``out`` must be C-contiguous
+    float32 sized exactly rows*cols for the file; False on any mismatch
+    (capacity, ragged rows, missing library) — caller falls back."""
+    lib = _find_lib()
+    if lib is None:
+        return False
+    if out.dtype != np.float32 or not out.flags["C_CONTIGUOUS"]:
+        return False
     rc = lib.harp_parse_csv(path.encode(), sep.encode()[:1],
                             out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
                             out.size)
-    return out if rc == 0 else None
+    return rc == 0
+
+
+def parse_csv(path: str, sep: str = ",") -> Optional[np.ndarray]:
+    shape = count_csv(path, sep)
+    if shape is None:
+        return None
+    out = np.empty(shape, dtype=np.float32)
+    return out if parse_csv_into(path, out, sep) else None
+
+
+def count_lines(path: str) -> Optional[int]:
+    lib = _find_lib()
+    if lib is None:
+        return None
+    n = lib.harp_count_lines(path.encode())
+    return int(n) if n >= 0 else None
+
+
+def parse_coo_into(path: str, rows: np.ndarray, cols: np.ndarray,
+                   vals: np.ndarray) -> bool:
+    """Parse a COO part directly into caller-owned (int64, int64, f32)
+    buffers of exactly the file's line count (views into preallocated
+    whole-dataset arrays). False on mismatch or missing library."""
+    lib = _find_lib()
+    if lib is None:
+        return False
+    if (rows.dtype != np.int64 or cols.dtype != np.int64
+            or vals.dtype != np.float32
+            or not (rows.flags["C_CONTIGUOUS"] and cols.flags["C_CONTIGUOUS"]
+                    and vals.flags["C_CONTIGUOUS"])):
+        return False
+    rc = lib.harp_parse_coo(path.encode(),
+                            rows.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+                            cols.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+                            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                            len(rows))
+    return rc == 0
 
 
 def parse_coo(path: str, sep: str = " "
               ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
     if sep not in (" ", "\t"):
         return None  # native parser tokenizes by whitespace only; numpy fallback
-    lib = _find_lib()
-    if lib is None:
-        return None
-    n = lib.harp_count_lines(path.encode())
-    if n < 0:
+    n = count_lines(path)
+    if n is None:
         return None
     rows = np.empty(n, dtype=np.int64)
     cols = np.empty(n, dtype=np.int64)
     vals = np.empty(n, dtype=np.float32)
-    rc = lib.harp_parse_coo(path.encode(),
-                            rows.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
-                            cols.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
-                            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n)
-    return (rows, cols, vals) if rc == 0 else None
+    return (rows, cols, vals) if parse_coo_into(path, rows, cols, vals) else None
 
 
 def coo_to_csr(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,

@@ -101,6 +101,7 @@ class KMeans:
                 "per-block quantization scales) — use any other variant")
         self.session = session
         self.config = config
+        self._mb_steps = {}   # (budget, cols) -> compiled minibatch step
         self._fit = self._build()
 
     def _build(self):
@@ -338,6 +339,120 @@ class KMeans:
     def fit_prepared(self, pts: jax.Array, cen: jax.Array):
         """Run training on already-placed device arrays (no H2D in the hot path)."""
         return self._fit(pts, cen)
+
+    def fit_from_stream(self, chunks, centroids0, total_rows: int,
+                        *, metrics=None) -> Tuple[jax.Array, jax.Array]:
+        """Stream-fed training (io/pipeline.StreamLoader): assemble the
+        chunk stream into the SAME row-sharded, feature-padded device block
+        :meth:`prepare` would place for the identical data, then run the
+        unchanged compiled fit — BITWISE-equal to ``fit(points, centroids0)``
+        when the stream carries the same rows in one pass order
+        (``assemble_stream`` holds the placement contract; chunk N+1's
+        parse + H2D overlaps chunk N's device scatter when the stream rides
+        a ``DevicePrefetcher``).
+
+        ``total_rows`` must divide the mesh — truncate at ingest, exactly
+        like :func:`loaders.truncate_to_workers`; streamed rows past it are
+        masked off on device.
+        """
+        from harp_tpu.io import pipeline as io_pipeline
+        from harp_tpu.utils.metrics import Metrics
+
+        metrics = metrics if metrics is not None else Metrics()
+        pts = io_pipeline.assemble_stream(
+            self.session, chunks, total_rows, self._d_pad,
+            ("bfloat16" if self.config.compute_dtype == "bfloat16"
+             else "float32"), metrics=metrics)
+        cen = self.session.replicate_put(
+            jnp.asarray(np.asarray(centroids0), jnp.float32))
+        with metrics.timer("ingest.compute"):
+            out = self._fit(pts, cen)
+            jax.block_until_ready(out)
+        return out
+
+    def _minibatch_step(self, budget: int, cols: int):
+        """Compile (and cache per chunk shape) the one-chunk minibatch
+        E-step + online M-step program fit_stream_minibatch folds over."""
+        key = (budget, cols)
+        if key in self._mb_steps:
+            return self._mb_steps[key]
+        sess, cfg = self.session, self.config
+        w = sess.num_workers
+        if budget % w:
+            raise ValueError(
+                f"chunk budget {budget} must divide over {w} workers "
+                f"(StreamLoader chunk_rows)")
+        k_pad, d_pad = self._k_pad, self._d_pad
+        cdtype = None if cfg.compute_dtype == "float32" else jnp.dtype(
+            cfg.compute_dtype)
+
+        def step_fn(pts, mask, cen, counts):
+            x = lane_pack.pad_cols(pts, d_pad)
+            scores = distance.pairwise_scores(x, cen, cdtype)   # (b, k_pad)
+            scores = jnp.where(
+                jnp.arange(k_pad)[None, :] < cfg.num_centroids,
+                scores, jnp.inf)
+            onehot = jax.nn.one_hot(jnp.argmin(scores, axis=1), k_pad,
+                                    dtype=jnp.float32) * mask[:, None]
+            sums = jax.lax.dot_general(
+                onehot, x.astype(jnp.float32), (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            cnt = jnp.sum(onehot, axis=0)
+            sums = jax.lax.psum(sums, lax_ops.WORKERS)
+            cnt = jax.lax.psum(cnt, lax_ops.WORKERS)
+            new_counts = counts + cnt
+            # MacQueen online mean: fold this chunk's sums into the running
+            # per-centroid mean weighted by cumulative counts
+            new_cen = jnp.where(
+                new_counts[:, None] > 0,
+                (counts[:, None] * cen + sums)
+                / jnp.maximum(new_counts[:, None], 1.0),
+                cen)
+            xf = x.astype(jnp.float32)
+            sq = (jnp.sum(jnp.min(scores, axis=1) * mask)
+                  + jnp.sum((xf * xf) * mask[:, None]))
+            cost = jax.lax.psum(sq, lax_ops.WORKERS)
+            return new_cen, new_counts, cost
+
+        fn = sess.spmd(
+            step_fn,
+            in_specs=(sess.shard(), sess.shard(), sess.replicate(),
+                      sess.replicate()),
+            out_specs=(sess.replicate(), sess.replicate(),
+                       sess.replicate()))
+        self._mb_steps[key] = fn
+        return fn
+
+    def fit_stream_minibatch(self, chunks, centroids0
+                             ) -> Tuple[np.ndarray, np.ndarray]:
+        """True streaming path for unbounded chunk streams (the DrJAX-style
+        minibatch discipline, PAPERS.md arXiv:2403.07128): one E-step per
+        chunk against the CURRENT centroids, folded into a running mean
+        weighted by cumulative per-centroid counts.  Chunk order IS the
+        algorithm here, so this is convergence-equivalent — NOT bitwise —
+        to the batch fit; use :meth:`fit_from_stream` when the stream is a
+        finite dataset and bitwise parity matters.  Returns
+        (centroids (k, d), per-chunk cost trace).
+        """
+        sess, cfg = self.session, self.config
+        cen = sess.replicate_put(lane_pack.pad_rows(lane_pack.pad_cols(
+            jnp.asarray(np.asarray(centroids0), jnp.float32),
+            self._d_pad), self._k_pad))
+        counts = sess.replicate_put(jnp.zeros((self._k_pad,), jnp.float32))
+        costs = []
+        for ch in chunks:
+            data = ch.data
+            budget, cols = int(np.shape(data)[0]), int(np.shape(data)[1])
+            step = self._minibatch_step(budget, cols)
+            mask = (np.arange(budget) < ch.rows).astype(np.float32)
+            pts = data if isinstance(data, jax.Array) else sess.scatter(
+                np.ascontiguousarray(data, np.float32))
+            cen, counts, cost = step(pts, sess.scatter(mask), cen, counts)
+            costs.append(cost)
+        cen_h = np.asarray(cen)[:cfg.num_centroids, :cfg.dim]
+        cost_h = (np.asarray(jnp.stack(costs)) if costs
+                  else np.zeros(0, np.float32))
+        return cen_h, cost_h
 
     def fit_checkpointed(self, pts: jax.Array, cen: jax.Array, checkpointer,
                          save_every: int = 1,

@@ -48,12 +48,22 @@ class DynamicScheduler(Generic[I, O]):
     Each of the ``tasks`` (one per worker thread, matching Harp where each thread
     owned a Task instance with private scratch state) pulls from one shared input
     queue; results land in an output queue consumed via :meth:`wait_for_output`.
+
+    ``out_capacity`` bounds the OUTPUT queue (0 = unbounded, the classic Harp
+    contract). A bounded output queue is the backpressure seam the streaming
+    ingestion pipeline (io/pipeline.py) rides: worker threads block in their
+    result publish once ``out_capacity`` results are waiting, so a slow
+    consumer caps parsed-but-unconsumed data at ``out_capacity`` items plus
+    the one in-flight item per thread — memory stays flat at GB scale. With
+    a bounded queue, :meth:`stop`/:meth:`pause` may discard unclaimed
+    results (they must, to unblock workers stuck publishing into a full
+    queue); streaming consumers stop only once the stream is drained.
     """
 
-    def __init__(self, tasks: List[Task[I, O]]):
+    def __init__(self, tasks: List[Task[I, O]], out_capacity: int = 0):
         self._tasks = tasks
         self._in: "queue.Queue[Optional[I]]" = queue.Queue()
-        self._out: "queue.Queue[O]" = queue.Queue()
+        self._out: "queue.Queue[O]" = queue.Queue(maxsize=max(0, out_capacity))
         self._threads: List[threading.Thread] = []
         self._running = False
         self._submitted = 0
@@ -143,8 +153,25 @@ class DynamicScheduler(Generic[I, O]):
             return
         for _ in self._threads:
             self._in.put(None)
+        bounded = self._out.maxsize > 0
         for th in self._threads:
-            th.join()
+            if not bounded:
+                th.join()
+                continue
+            while True:
+                th.join(timeout=0.05)
+                if not th.is_alive():
+                    break
+                # Bounded output: the worker may be blocked PUBLISHING a
+                # result nobody will claim (stop/pause discard unclaimed
+                # results in bounded mode by contract) — make room so the
+                # poison pill can reach it. Each discarded result releases
+                # one submitted-slot the consumer will never claim.
+                try:
+                    self._out.get_nowait()
+                    self._submitted -= 1
+                except queue.Empty:
+                    pass
         self._threads.clear()
         self._running = False
 

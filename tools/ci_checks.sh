@@ -121,6 +121,20 @@
 # policy accepts by construction (it flags bf16-accumulating dots, not
 # integer dots).
 #
+#   9. ingest smoke — ISSUE 18: the streaming ingestion engine end to end
+#                     (tools/ingest_smoke.py): part-files through the
+#                     bounded reader pool must reproduce the in-memory
+#                     load row for row; the stream-fed
+#                     KMeans.fit_from_stream (through the DevicePrefetcher
+#                     H2D thread) must match the in-memory fit BITWISE;
+#                     and the device COO regroup on the jaxlint-pinned
+#                     ingest_coo_regroup bounded all_to_all schedule (480
+#                     B/step at the traced shape — degrading toward a full
+#                     gather fails stage 1's JL203) must match the
+#                     host-shuffle oracle nnz for nnz, with the
+#                     distributed COO→CSR matching the per-block
+#                     counting-sort oracle exactly.
+#
 # Any stage failing fails the script; all stages always run (a lint
 # finding must not hide a test regression or vice versa).
 
@@ -128,15 +142,15 @@ set -u
 cd "$(dirname "$0")/.."
 rc=0
 
-echo "== [1/8] jaxlint (AST + JL3xx concurrency + jaxpr + gang budgets + artifact manifest) =="
+echo "== [1/9] jaxlint (AST + JL3xx concurrency + jaxpr + gang budgets + artifact manifest) =="
 python -m tools.jaxlint || rc=1
 
-echo "== [2/8] jaxlint budget with telemetry + request tracing ON (zero drift) =="
+echo "== [2/9] jaxlint budget with telemetry + request tracing ON (zero drift) =="
 tele_dir="$(mktemp -d /tmp/_tele_gate.XXXXXX)"
 HARP_TELEMETRY_DIR="$tele_dir" HARP_TRACE_REQUESTS=1 \
     python -m tools.jaxlint --jaxpr-only || rc=1
 
-echo "== [3/8] gang-mode collective budgets (virtual multi-process mesh) =="
+echo "== [3/9] gang-mode collective budgets (virtual multi-process mesh) =="
 # ISSUE 13: the dryrun_multichip gang-mode step programs traced on the
 # virtual 2-host x 4-device mesh with the workers axis hinted DCN —
 # counts, per-process shard shapes, and the DCN/ICI link-class byte split
@@ -147,10 +161,10 @@ echo "== [3/8] gang-mode collective budgets (virtual multi-process mesh) =="
 # its own stage banner in CI output instead of buried in stage 1's.
 python -m tools.jaxlint --gang-only || rc=1
 
-echo "== [4/8] check_claims =="
+echo "== [4/9] check_claims =="
 python tools/check_claims.py || rc=1
 
-echo "== [5/8] tier-1 tests =="
+echo "== [5/9] tier-1 tests =="
 set -o pipefail
 t1_log="$(mktemp /tmp/_t1.XXXXXX.log)"   # unique per run: concurrent CI
 trap 'rm -f "$t1_log"; rm -rf "$tele_dir"' EXIT   # must not clobber the count
@@ -160,16 +174,19 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$t1_log" \
     | tr -cd . | wc -c)"
 
-echo "== [6/8] serving-chaos smoke (scripted kill under load, zero failures) =="
+echo "== [6/9] serving-chaos smoke (scripted kill under load, zero failures) =="
 # bounded like stage 5: a wedged recovery (the exact machinery this smoke
 # exercises) must fail CI, never hang it
 timeout -k 10 300 python -m tools.serving_chaos_smoke || rc=1
 
-echo "== [7/8] aot artifact round-trip (export -> hash-check -> load -> parity) =="
+echo "== [7/9] aot artifact round-trip (export -> hash-check -> load -> parity) =="
 timeout -k 10 300 python -m tools.aot_roundtrip_smoke || rc=1
 
-echo "== [8/8] overload + network chaos smoke (QPS ramp + netdrop + kill, autoscale up/down, zero failures) =="
+echo "== [8/9] overload + network chaos smoke (QPS ramp + netdrop + kill, autoscale up/down, zero failures) =="
 timeout -k 10 300 python -m tools.overload_chaos_smoke || rc=1
+
+echo "== [9/9] streaming-ingestion smoke (chunk stream, stream-vs-memory bitwise fit, device COO regroup) =="
+timeout -k 10 300 python -m tools.ingest_smoke || rc=1
 
 if [ "$rc" -ne 0 ]; then
     echo "ci_checks: FAILED"

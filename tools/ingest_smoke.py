@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Streaming-ingestion smoke (ISSUE 18 satellite — ci_checks stage 9).
+
+One bounded, self-contained pass over the ingestion engine's contracts:
+
+  1. STREAM   — synthetic part-files (ragged sizes on purpose) through the
+               bounded reader pool; the chunk sequence must cover every row
+               in path order at the fixed budget shape;
+  2. PARITY   — ``KMeans.fit_from_stream`` fed through a
+               ``DevicePrefetcher`` must produce BITWISE-identical
+               centroids and costs to ``fit`` on the same rows loaded in
+               memory (the assemble_stream placement contract);
+  3. REGROUP  — the device COO regroup (the jaxlint-pinned
+               ``ingest_coo_regroup`` bounded all_to_all schedule) must
+               match the host-shuffle oracle nnz for nnz, and the
+               distributed COO→CSR must match the per-block counting-sort
+               oracle exactly.
+
+Exit nonzero on any failure. Usage: ``python -m tools.ingest_smoke``.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import tempfile
+
+
+def main() -> int:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from tools.jaxlint.trace_targets import ensure_cpu_mesh
+
+    ensure_cpu_mesh()
+    import numpy as np
+
+    from harp_tpu.io import loaders, pipeline as pl
+    from harp_tpu.models import kmeans as km
+    from harp_tpu.session import HarpSession
+
+    sess = HarpSession()
+    w = sess.num_workers
+    rng = np.random.default_rng(1800)
+    tmp = tempfile.mkdtemp(prefix="harp_ingest_smoke_")
+    try:
+        # 1. STREAM ---------------------------------------------------------
+        sizes, d = [53, 7, 64, 20], 6
+        for i, n in enumerate(sizes):
+            np.savetxt(os.path.join(tmp, f"part-{i:03d}"),
+                       rng.standard_normal((n, d)).astype(np.float32),
+                       fmt="%.6f", delimiter=",")
+        paths = loaders.list_files(tmp)
+        whole = loaders.load_dense_csv(paths)
+        chunks = list(pl.StreamLoader(paths, chunk_rows=32, num_threads=3))
+        assert all(c.data.shape == (32, d) for c in chunks), "budget shape"
+        flat = np.concatenate([c.data[: c.rows] for c in chunks])
+        assert np.array_equal(flat, whole), "stream coverage/order"
+        print(f"ingest_smoke: stream ok ({len(chunks)} chunks, "
+              f"{len(whole)} rows)")
+
+        # 2. PARITY ---------------------------------------------------------
+        pts = loaders.truncate_to_workers(whole, w)
+        cen0 = whole[:4].copy()
+        model = km.KMeans(sess, km.KMeansConfig(
+            num_centroids=4, dim=d, iterations=3))
+        ref_cen, ref_costs = model.fit(pts, cen0)
+        cen, costs = model.fit_from_stream(
+            pl.DevicePrefetcher(
+                pl.StreamLoader(paths, chunk_rows=32, num_threads=3),
+                sess.replicate_put),
+            cen0, len(pts))
+        assert np.array_equal(np.asarray(cen), np.asarray(ref_cen)), \
+            "stream-fed centroids not bitwise-equal to in-memory fit"
+        assert np.array_equal(np.asarray(costs), np.asarray(ref_costs)), \
+            "stream-fed costs not bitwise-equal to in-memory fit"
+        print("ingest_smoke: stream-vs-memory fit bitwise parity ok")
+
+        # 3. REGROUP --------------------------------------------------------
+        num_rows, nnz = 101, 5000
+        crow = rng.integers(0, num_rows, nnz).astype(np.int64)
+        ccol = rng.integers(0, 77, nnz).astype(np.int64)
+        cval = rng.standard_normal(nnz).astype(np.float32)
+        got = pl.regroup_coo_device(sess, crow, ccol, cval,
+                                    num_rows=num_rows)
+        block = -(-num_rows // w)
+        owner = np.minimum(crow // block, w - 1)
+        for wi in range(w):
+            m = owner == wi
+            assert np.array_equal(got[wi][0], crow[m]) \
+                and np.array_equal(got[wi][1], ccol[m]) \
+                and np.array_equal(got[wi][2], cval[m]), \
+                f"regroup worker {wi} != host oracle"
+        csr = pl.coo_to_csr_distributed(sess, crow, ccol, cval,
+                                        num_rows=num_rows)
+        for wi in range(w):
+            lo, hi = wi * block, min((wi + 1) * block, num_rows)
+            m = (crow >= lo) & (crow < hi)
+            ip, ix, v = loaders.coo_to_csr(crow[m] - lo, ccol[m], cval[m],
+                                           num_rows=max(hi - lo, 0))
+            assert np.array_equal(csr[wi][0], ip) \
+                and np.array_equal(csr[wi][1], ix) \
+                and np.array_equal(csr[wi][2], v), \
+                f"distributed CSR worker {wi} != per-block oracle"
+        print(f"ingest_smoke: device regroup + distributed CSR ok "
+              f"({nnz} nnz over {w} workers)")
+        print("ingest_smoke: PASS")
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

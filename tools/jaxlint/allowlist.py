@@ -94,6 +94,12 @@ ALLOWLIST: Allowlist = {
         "calls out why collective ops must stay boundary-aligned)",
 
     # -- JL105 broad-except: blast radius deliberately wide ----------------
+    ("harp_tpu/io/pipeline.py", "_run", "JL105"):
+        "the prefetch thread envelopes ANY producer failure (parse error, "
+        "fsspec IO, device_put OOM) into the chunk queue so it re-raises "
+        "on the CONSUMER's thread — same contract as DynamicScheduler's "
+        "_TaskError; a narrowed except would hang the consumer on a "
+        "missing sentinel instead",
     ("harp_tpu/aot/store.py", "load", "JL105"):
         "deserializing a stale/foreign artifact payload can raise "
         "anything the jax.export/StableHLO loader reaches; the contract "

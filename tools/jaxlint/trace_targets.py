@@ -370,6 +370,33 @@ def _reshard(schedule: str):
     return build
 
 
+def _ingest_coo_regroup():
+    """r19 (ISSUE 18): the streaming-ingestion COO regroup step program
+    (io/pipeline.regroup_coo_device) — parsed nonzeros routed to their
+    row-block owner by the SAME bounded all_to_all schedule as the reshard
+    engine, packed as 20 B (row i64, col i64, val f32) records.  A 512 B
+    chunk budget at the tier-1 shape keeps multiple rounds in the traced
+    program, so the pinned bytes-per-step row IS the per-round foreign
+    footprint: a regroup degrading toward a whole-table gather grows it
+    and fails JL203."""
+    import numpy as np
+
+    from harp_tpu.collectives import reshard as rs
+    from harp_tpu.io import pipeline as pl
+
+    sess = _session()
+    rng = _rng()
+    n, num_rows = 300, 97
+    rows = rng.integers(0, num_rows, n).astype(np.int64)
+    cols = rng.integers(0, 64, n).astype(np.int64)
+    vals = rng.standard_normal(n).astype(np.float32)
+    plan, counts, cap = rs.plan_coo_regroup(rows, num_rows, NUM_WORKERS,
+                                            chunk_bytes=512)
+    rec = pl.pack_coo(rows, cols, vals)
+    fill = sess.scatter(np.zeros((NUM_WORKERS * cap, 5), np.int32))
+    return rs.prepare_reshard(sess, rec, plan, fill)
+
+
 # Registry: target name -> builder returning (traceable callable, args).
 # Names are the manifest keys — renaming one is a manifest change.
 # The *_int8/*_bf16 rows pin the QUANTIZED step programs: their byte rows
@@ -432,6 +459,12 @@ TARGETS: Dict[str, Callable[[], Tuple[Callable, tuple]]] = {
     # budget row that makes a silent f32 revert on the REQUEST path as
     # loud as one on a training path.
     "serve_topk_mf_int8": _serve_topk_int8,
+    # r19 (ISSUE 18): the streaming-ingestion distributed COO regroup — the
+    # per-round all_to_all operand bytes ARE the ≤ chunk_bytes budget
+    # (8 peers x 3 records x 20 B = 480 B at the traced 512 B budget); a
+    # regroup silently reverting to a whole-table host/device gather
+    # changes kinds or grows bytes and fails JL201/JL203.
+    "ingest_coo_regroup": _ingest_coo_regroup,
 }
 
 
