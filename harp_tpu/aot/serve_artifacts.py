@@ -87,12 +87,20 @@ def export_endpoint(store: ArtifactStore, ep, *,
     offline ``aot warm`` path — this TRACES each bucket in the exporting
     process, which is the whole point: the trace happens here, once, not
     in every cold worker). Returns ``{bucket: meta}``."""
+    import jax
+
+    from harp_tpu.aot import static_memory
+
     out = {}
     for bucket in (ep.bucket_sizes if buckets is None else buckets):
         fn = ep.compiled(bucket)
         args = ep.dispatch_args(bucket)
+        # the static memory row rides along as placement metadata (never
+        # a key axis): the mall reads resident/peak bytes off the meta
+        # without deserializing the program
+        mem = static_memory.memory_row(jax.make_jaxpr(fn)(*args))
         out[bucket] = store.export_and_put(
-            _key(ep, bucket, args, model_hash), fn, args)
+            _key(ep, bucket, args, model_hash), fn, args, memory=mem)
     return out
 
 

@@ -1296,11 +1296,20 @@ def run_aot(argv) -> int:
 
         for meta in ArtifactStore(args.aot_dir).list():
             # foreign/partial metas list with placeholders — the listing
-            # tool survives the same seams the store's readers do
+            # tool survives the same seams the store's readers do; the
+            # static memory row (resident/peak HBM bytes, ISSUE 19) is
+            # optional metadata, so its columns degrade the same way
+            mem = meta.get("memory") or {}
+            resident = mem.get("resident_arg_bytes")
+            peak = mem.get("peak_live_bytes")
+            mem_col = (f"res={int(resident):>8d} B peak={int(peak):>8d} B"
+                       if resident is not None and peak is not None
+                       else "res=       ? B peak=       ? B")
             print(f"{str(meta.get('name') or '?'):32s} "
                   f"{str(meta.get('format') or '?'):18s} "
                   f"world={meta.get('world')} "
                   f"{int(meta.get('payload_bytes') or 0):>8d} B  "
+                  f"{mem_col}  "
                   f"{str(meta.get('content_hash') or '')[:12]}")
         return 0
     # warm: the export traces run on a virtual CPU mesh at the fleet's

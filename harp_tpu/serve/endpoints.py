@@ -7,8 +7,11 @@ the sharded factor store scattered over the mesh), the compiled dispatch for
 each static batch bucket is built lazily and held in a cache container
 (``self._fns[bucket] = session.spmd(...)`` — the JL103-clean idiom), and
 every request after that is a pure dispatch: no retrace, no re-placement.
-Query buffers are DONATED (``donate_argnums`` on the batch argument) so XLA
-reuses the incoming bucket buffer instead of allocating per dispatch.
+Query buffers are NOT donated: every dispatch returns outputs whose
+shape/dtype differ from the query batch (scores/ids vs feature rows), so a
+``donate_argnums`` entry here can never alias an output — XLA would drop it
+with only a warning and the "reused" buffer would quietly double (the JL402
+donation audit pins this; see ``tools/jaxlint/checkers_memory.py``).
 
 Two endpoint families:
 
@@ -357,10 +360,12 @@ class ClassifyEndpoint(Endpoint):
                 params = self._dequant_params(params)
             return self._predict(params, x)
 
+        # no donation: the int32 label output can never alias the f32
+        # feature batch, so a donate_argnums here would be silently
+        # dropped by XLA (JL402)
         return sess.spmd(predict,
                          in_specs=(sess.replicate(), sess.shard()),
-                         out_specs=sess.shard(),
-                         donate_argnums=(1,))
+                         out_specs=sess.shard())
 
     def _dummy_batch(self) -> np.ndarray:
         if self.dim is None:
@@ -731,8 +736,9 @@ class TopKEndpoint(Endpoint):
             # build OFF-lock: dispatches keep serving the old epoch while
             # the new one transfers; block_until_ready = fully resident
             # before the swap is even attempted. Keys/counts/owner-map are
-            # layout, not payload — an epoch push reuses them as-is (the
-            # state args are never donated; only the query buffer is).
+            # layout, not payload — an epoch push reuses them as-is
+            # (dispatch arguments are never donated, so the resident
+            # state survives every dispatch untouched).
             w = sess.num_workers
             vals = np.zeros((w, cap, self._val_width), self._val_dtype)
             vals[owner, slot] = self._encode_vals(uf)
@@ -916,8 +922,7 @@ class TopKEndpoint(Endpoint):
                 topk_routed,
                 in_specs=(sess.shard(), sess.shard(), sess.shard(),
                           sess.replicate(), sess.replicate(), sess.shard()),
-                out_specs=(sess.shard(),) * 3,
-                donate_argnums=(5,))
+                out_specs=(sess.shard(),) * 3)
 
         def topk(keys, vals, count, items, q):
             self._count_trace(bucket)
@@ -930,10 +935,11 @@ class TopKEndpoint(Endpoint):
 
         return sess.spmd(
             topk,
+            # no donation: the int32 query ids can never alias the f32
+            # score / int32 top-k outputs of different shape (JL402)
             in_specs=(sess.shard(), sess.shard(), sess.shard(),
                       sess.replicate(), sess.shard()),
-            out_specs=(sess.shard(),) * 3,
-            donate_argnums=(4,))
+            out_specs=(sess.shard(),) * 3)
 
     def _note_lookup(self, ids: np.ndarray) -> None:
         """Accumulate the per-owner lookup histogram for one request-id

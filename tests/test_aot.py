@@ -363,3 +363,93 @@ def test_worker_aot_store_loads_before_serving(session, rng, tmp_path):
         client.close()
         for w in workers:
             w.close()
+
+
+# --------------------------------------------------------------------------- #
+# Static memory rows in artifact meta (ISSUE 19): metadata, never a key axis
+# --------------------------------------------------------------------------- #
+
+def test_export_records_static_memory_row_in_meta(session, rng, tmp_path):
+    from harp_tpu.aot.store import KEY_AXES
+
+    _m, store = _metrics_store(tmp_path)
+    ep, _uf, _items = _topk(session, rng)
+    metas = serve_artifacts.export_endpoint(store, ep, model_hash="h")
+    assert metas, metas
+    for meta in metas.values():
+        mem = meta["memory"]
+        assert mem["resident_arg_bytes"] > 0
+        assert mem["peak_live_bytes"] >= mem["resident_arg_bytes"]
+        assert mem["transient_peak_ratio"] > 1.0
+    # the row is placement METADATA: the key matrix is unchanged, so a
+    # memory field can never turn a load into a (or mask a real) miss
+    assert KEY_AXES == ("jax_version", "device_kind", "world", "quant",
+                        "layout", "model_hash")
+    assert not any(axis in ("memory", "resident_arg_bytes",
+                            "peak_live_bytes", "transient_peak_ratio")
+                   for axis in KEY_AXES)
+
+
+def test_memory_row_mismatch_or_absence_never_misses(session, rng,
+                                                     tmp_path):
+    # a doctored (or stripped — pre-r20 store) memory row must NOT reject
+    # the artifact: only KEY_AXES decide hit vs miss
+    m, store = _metrics_store(tmp_path)
+    ep, _uf, _items = _topk(session, rng)
+    serve_artifacts.export_endpoint(store, ep, model_hash="h")
+    name = serve_artifacts.dispatch_name("mf", 8)
+    _doctor_meta(store, name,
+                 memory={"resident_arg_bytes": 1, "peak_live_bytes": 2,
+                         "transient_peak_ratio": 2.0})
+    twin, _, _ = _topk(session, rng)
+    loaded = serve_artifacts.load_endpoint(store, twin, model_hash="h",
+                                           warm=False)
+    assert loaded == [8], loaded
+    # strip the row entirely: still a hit
+    path = store._paths(name)[0]
+    with open(path) as f:
+        meta = json.load(f)
+    del meta["memory"]
+    with open(path, "w") as f:
+        json.dump(meta, f)
+    twin2, _, _ = _topk(session, rng)
+    loaded = serve_artifacts.load_endpoint(store, twin2, model_hash="h",
+                                           warm=False)
+    assert loaded == [8], loaded
+    assert m.snapshot()["counters"]["aot.store.hit"] == 2
+
+
+def test_aot_ls_prints_resident_and_peak_bytes(session, rng, tmp_path,
+                                               capsys):
+    from harp_tpu.run import run_aot
+
+    _m, store = _metrics_store(tmp_path)
+    ep, _uf, _items = _topk(session, rng)
+    metas = serve_artifacts.export_endpoint(store, ep, model_hash="h")
+    # one artifact with a pre-r20 (row-less) meta: the listing degrades
+    # to placeholders instead of crashing
+    name = serve_artifacts.dispatch_name("mf", 8)
+    path = store._paths(name)[0]
+    with open(path) as f:
+        meta = json.load(f)
+    stripped = dict(meta)
+    del stripped["memory"]
+    alt = str(tmp_path / "store2")
+    store2 = ArtifactStore(alt)
+    os.makedirs(os.path.dirname(store2._paths(name)[0]), exist_ok=True)
+    with open(store2._paths(name)[0], "w") as f:
+        json.dump(stripped, f)
+    with open(store._paths(name)[1], "rb") as f:
+        payload = f.read()
+    with open(store2._paths(name)[1], "wb") as f:
+        f.write(payload)
+
+    assert run_aot(["ls", "--aot-dir", str(tmp_path / "store")]) == 0
+    out = capsys.readouterr().out
+    mem = metas[8]["memory"]
+    assert f"res={mem['resident_arg_bytes']:>8d} B" in out
+    assert f"peak={mem['peak_live_bytes']:>8d} B" in out
+
+    assert run_aot(["ls", "--aot-dir", alt]) == 0
+    out = capsys.readouterr().out
+    assert "res=       ? B peak=       ? B" in out

@@ -826,3 +826,212 @@ def test_jl301_nested_fn_thread_target_makes_method_a_root():
         "        return self.state\n")
     got = _runc(src)
     assert [(f.func, f.code) for f in got] == [("start", "JL301")], got
+
+
+# -- JL4xx static memory engine (ISSUE 19) ----------------------------------
+
+import numpy as np  # noqa: E402
+
+from harp_tpu.aot import static_memory  # noqa: E402
+from tools.jaxlint import checkers_memory  # noqa: E402
+
+
+def _memory_manifest_rows():
+    with open(os.path.join(REPO, checkers_memory.BUDGET_FILE)) as f:
+        return json.load(f)["memory"]
+
+
+def test_memory_manifest_pins_twelve_plus_targets():
+    rows = _memory_manifest_rows()
+    assert len(rows) >= 12, sorted(rows)
+    for name, row in rows.items():
+        assert set(checkers_memory.MEMORY_FIELDS) <= set(row), name
+        assert row["resident_arg_bytes"] > 0, name
+        assert row["peak_live_bytes"] >= row["resident_arg_bytes"], name
+        assert row["transient_peak_ratio"] == round(
+            row["peak_live_bytes"] / row["resident_arg_bytes"],
+            static_memory.RATIO_DIGITS), name
+        # every committed program sits under the JL404 absolute guard
+        assert (row["transient_peak_ratio"]
+                < checkers_memory.TRANSIENT_BLOWUP_RATIO), name
+    # both serving dispatches are pinned, and the int8 resident footprint
+    # sits strictly below the f32 twin's — the quantized mode's memory
+    # story, now a static number the mall can plan on
+    assert (rows["serve_topk_mf_int8"]["resident_arg_bytes"]
+            < rows["serve_topk_mf"]["resident_arg_bytes"])
+    assert "serve_classify_nn" in rows
+    assert any(name.startswith("gang2x4_") for name in rows), sorted(rows)
+    # manifest rows self-check clean against themselves
+    assert checkers_memory.check_memory_budget(REPO, dict(rows)) == []
+
+
+def test_memory_doctored_peak_row_fails_jl401():
+    # the acceptance criterion: doctoring a peak_live_bytes row fails
+    # JL401 loudly, and ONLY for the doctored target
+    rows = _memory_manifest_rows()
+    doctored = copy.deepcopy(rows)
+    doctored["serve_topk_mf"]["peak_live_bytes"] += 4096
+    findings = checkers_memory.check_memory_budget(REPO, doctored)
+    hits = [f for f in findings
+            if f.code == "JL401" and f.func == "serve_topk_mf"]
+    assert hits and "drift" in hits[0].message, findings
+    assert "peak_live_bytes" in hits[0].message
+    assert all(f.func == "serve_topk_mf" for f in findings), findings
+
+
+def test_memory_missing_stale_and_absent_section_are_loud(tmp_path):
+    rows = _memory_manifest_rows()
+    # a traced target with no manifest row
+    extra = copy.deepcopy(rows)
+    extra["serve_new_workload"] = dict(extra[sorted(extra)[0]])
+    findings = checkers_memory.check_memory_budget(REPO, extra)
+    assert any(f.code == "JL401" and "no memory row" in f.message
+               for f in findings)
+    # a manifest row whose target vanished
+    short = copy.deepcopy(rows)
+    dropped = sorted(short)[0]
+    del short[dropped]
+    findings = checkers_memory.check_memory_budget(REPO, short)
+    assert any(f.code == "JL401" and f.func == dropped
+               and "stale" in f.message for f in findings)
+    # a manifest missing the whole memory section (pre-r20 checkout)
+    (tmp_path / "tools").mkdir()
+    (tmp_path / "tools" / "collective_budget.json").write_text(
+        json.dumps({"targets": {}}))
+    findings = checkers_memory.check_memory_budget(str(tmp_path), rows)
+    assert [f.code for f in findings] == ["JL401"], findings
+    assert "no memory section" in findings[0].message
+
+
+def test_jl402_dropped_donation_fixture_and_honored_twin(session):
+    import jax
+
+    x = np.ones(8, np.float32)
+    # f32 input donated, scalar output: no output aval matches, XLA
+    # drops the donation silently — JL402's reason to exist
+    dropped = jax.make_jaxpr(
+        lambda v: jax.jit(lambda y: y.sum(), donate_argnums=(0,))(v))(x)
+    findings = checkers_memory.donation_findings(dropped, "fixture")
+    assert [f.code for f in findings] == ["JL402"], findings
+    assert "aliases NO output" in findings[0].message
+    assert findings[0].func == "fixture"
+    # the clean twin: same donation, but the output aval matches — the
+    # donation is honored, nothing fires
+    honored = jax.make_jaxpr(
+        lambda v: jax.jit(lambda y: y + 1, donate_argnums=(0,))(v))(x)
+    assert checkers_memory.donation_findings(honored, "fixture") == []
+
+
+def test_jl403_constant_bloat_fixture_and_small_const_twin(session):
+    import jax
+
+    big = np.ones((128, 128), np.float32)      # 64 KiB: at the threshold
+    bloated = jax.make_jaxpr(lambda v: v[:128, :128] + big)(
+        np.ones((256, 256), np.float32))
+    findings = checkers_memory.const_findings(bloated, "fixture")
+    assert [f.code for f in findings] == ["JL403"], findings
+    assert "65536 B" in findings[0].message
+    # the clean twin: a tiny closed-over constant rides below threshold
+    small = np.ones((4,), np.float32)
+    lean = jax.make_jaxpr(lambda v: v + small)(np.ones(4, np.float32))
+    assert checkers_memory.const_findings(lean, "fixture") == []
+
+
+def test_jl404_broadcast_blowup_fixture_and_calm_twin(session):
+    import jax
+    import jax.numpy as jnp
+
+    x = np.ones(8, np.float32)
+    # 32 B of arguments materializing a 128 KiB broadcast: the static
+    # signature of an accidental full gather/broadcast
+    blown = jax.make_jaxpr(
+        lambda v: jnp.broadcast_to(v, (4096, 8)).sum())(x)
+    findings = checkers_memory.transient_findings(blown, "fixture")
+    assert [f.code for f in findings] == ["JL404"], findings
+    assert "4097.0x" in findings[0].message
+    calm = jax.make_jaxpr(lambda v: v * 2.0)(x)
+    assert checkers_memory.transient_findings(calm, "fixture") == []
+
+
+def test_memory_traced_rows_match_committed_manifest(session):
+    # the end-to-end gate: re-analyzing every traced program reproduces
+    # the committed memory rows exactly, and the repo's own programs
+    # carry no JL402/403/404 hazards (every donation aliases, no captured
+    # constants above threshold, no transient blowup)
+    mem = checkers_memory.trace_memory_all()
+    findings = checkers_memory.check_memory_budget(REPO, mem)
+    assert findings == [], "\n".join(str(f) for f in findings)
+    assert len(mem) >= 12
+    assert checkers_memory.check_memory_hazards() == []
+
+
+def test_static_resident_bytes_cross_checks_endpoint_gauge(session):
+    # the mall-planning contract: the static resident estimate equals the
+    # endpoint's runtime resident-state gauge plus the placed query
+    # buffer (the only dispatch argument that is not resident state) —
+    # for BOTH endpoint families, and both match the committed rows
+    # (the manifest is traced at these exact tier-1 shapes)
+    import jax
+
+    from harp_tpu.models import nn
+    from harp_tpu.serve import endpoints as serve_ep
+
+    rows = _memory_manifest_rows()
+    rng = np.random.default_rng(0)
+    uf = rng.normal(size=(64, 8)).astype(np.float32)
+    items = rng.normal(size=(32, 8)).astype(np.float32)
+    ep = serve_ep.TopKEndpoint(session, "mf", uf, items, k=4)
+    ids = rng.integers(0, 64, size=ep.bucket_sizes[0])
+    fn, args, _n, _bucket = ep.prepared(ids)
+    row = static_memory.memory_row(jax.make_jaxpr(fn)(*args))
+    assert row["resident_arg_bytes"] == (
+        ep.resident_bytes() + int(args[-1].nbytes))
+    assert row == rows["serve_topk_mf"]
+
+    model = nn.MLPClassifier(session, nn.NNConfig(layers=(8,),
+                                                  num_classes=3))
+    model.params = nn.init_params((12, 8, 3), seed=0)
+    cep = serve_ep.classify_from_nn(session, model, name="nn")
+    x = rng.normal(size=(cep.bucket_sizes[0], 12)).astype(np.float32)
+    cfn, cargs, _cn, _cbucket = cep.prepared(x)
+    crow = static_memory.memory_row(jax.make_jaxpr(cfn)(*cargs))
+    assert crow["resident_arg_bytes"] == (
+        cep.resident_bytes() + int(cargs[-1].nbytes))
+    assert crow == rows["serve_classify_nn"]
+
+
+def test_memory_only_flag_runs_exactly_one_engine(session, capsys):
+    from tools.jaxlint.__main__ import main as jaxlint_main
+
+    rc = jaxlint_main(["--memory-only"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "memory engine:" in out
+    for banner in ("ast engine", "jaxpr engine", "gang engine",
+                   "artifact engine"):
+        assert banner not in out, out
+
+
+def test_memory_doctored_manifest_fails_jl401_in_json_stream(
+        session, tmp_path, capsys):
+    # end to end through the CLI: a doctored peak in a copied manifest
+    # surfaces as a machine-readable JL401 record on the JSONL stream
+    # with the full record schema, and the exit goes nonzero
+    (tmp_path / "tools").mkdir()
+    with open(os.path.join(REPO, checkers_memory.BUDGET_FILE)) as f:
+        doc = json.load(f)
+    doc["memory"]["serve_topk_mf"]["peak_live_bytes"] += 4096
+    (tmp_path / "tools" / "collective_budget.json").write_text(
+        json.dumps(doc))
+    from tools.jaxlint.__main__ import main as jaxlint_main
+
+    rc = jaxlint_main([str(tmp_path), "--memory-only", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    lines = [json.loads(line) for line in out.strip().splitlines()]
+    hits = [r for r in lines if r["code"] == "JL401"]
+    assert hits and hits[0]["func"] == "serve_topk_mf", out
+    assert hits[0]["allowlisted"] is False
+    assert "drift" in hits[0]["message"]
+    assert {"file", "line", "code", "checker", "func", "message",
+            "allowlisted"} <= set(hits[0])

@@ -440,6 +440,51 @@ CLAIMS: List[Claim] = [
           r"`ingest_coo_regroup` target, (\S+) B/step",
           ("targets", "ingest_coo_regroup", "bytes_per_step"),
           rel_tol=0.0, file="tools/collective_budget.json"),
+    # PERF.md r20 (ISSUE 19): the static memory table — per-target
+    # resident/peak/ratio rows pinned to the manifest's `memory` section
+    # (jaxlint JL401 keeps the manifest honest against the traced
+    # programs; these keep the PROSE honest against the manifest). Static
+    # rows are exact — zero tolerance.
+    Claim("mem_serve_topk_resident", "PERF.md",
+          r"serve_topk_mf \(f32 dispatch\) \| (\S+) B",
+          ("memory", "serve_topk_mf", "resident_arg_bytes"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("mem_serve_topk_peak", "PERF.md",
+          r"serve_topk_mf \(f32 dispatch\) \| \S+ B \| (\S+) B",
+          ("memory", "serve_topk_mf", "peak_live_bytes"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("mem_serve_topk_int8_resident", "PERF.md",
+          r"serve_topk_mf_int8 \(quantized\) \| (\S+) B",
+          ("memory", "serve_topk_mf_int8", "resident_arg_bytes"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("mem_serve_topk_int8_peak", "PERF.md",
+          r"serve_topk_mf_int8 \(quantized\) \| \S+ B \| (\S+) B",
+          ("memory", "serve_topk_mf_int8", "peak_live_bytes"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("mem_serve_classify_resident", "PERF.md",
+          r"serve_classify_nn \| (\S+) B",
+          ("memory", "serve_classify_nn", "resident_arg_bytes"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("mem_kmeans_allreduce_peak", "PERF.md",
+          r"\| kmeans_allreduce \| \S+ B \| (\S+) B",
+          ("memory", "kmeans_allreduce", "peak_live_bytes"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("mem_kmeans_int8_peak", "PERF.md",
+          r"\| kmeans_allreduce_int8 \| \S+ B \| (\S+) B",
+          ("memory", "kmeans_allreduce_int8", "peak_live_bytes"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("mem_kmeans_int8_ratio", "PERF.md",
+          r"\| kmeans_allreduce_int8 \| \S+ B \| \S+ B \| (\S+) \|",
+          ("memory", "kmeans_allreduce_int8", "transient_peak_ratio"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("mem_gang_rga_peak", "PERF.md",
+          r"\| gang2x4_kmeans_regroupallgather \| \S+ B \| (\S+) B",
+          ("memory", "gang2x4_kmeans_regroupallgather", "peak_live_bytes"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("mem_ingest_regroup_resident", "PERF.md",
+          r"\| ingest_coo_regroup \| (\S+) B",
+          ("memory", "ingest_coo_regroup", "resident_arg_bytes"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
 ]
 
 

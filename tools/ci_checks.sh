@@ -121,6 +121,31 @@
 # policy accepts by construction (it flags bf16-accumulating dots, not
 # integer dots).
 #
+#   10. memory budgets — ISSUE 19 (r20): the STATIC MEMORY engine (JL4xx,
+#                     tools/jaxlint/checkers_memory.py) as its own
+#                     attributable stage: liveness analysis over every
+#                     traced program in BOTH registries pins per-target
+#                     resident_arg_bytes / peak_live_bytes /
+#                     transient_peak_ratio rows in the manifest's `memory`
+#                     section (JL401 — drift fails exactly like JL203
+#                     byte-drift; a grown static peak is the OOM that
+#                     would otherwise ship invisibly, a grown resident set
+#                     changes what the model mall can co-locate), audits
+#                     every donate_argnums buffer for provable
+#                     output aliasing (JL402 — XLA drops a mismatched
+#                     donation with only a warning, doubling the buffer
+#                     the caller believes is reused), flags closed-over
+#                     constants ≥ 64 KiB baked into jaxprs (JL403), and
+#                     flags any program whose liveness peak exceeds 20x
+#                     its resident argument bytes (JL404 — the static
+#                     signature of an accidental full gather/broadcast
+#                     materialization). Stages 1-2 already run the engine
+#                     inside their full/telemetry passes; this pass gives
+#                     memory-budget failures their own CI banner. The same
+#                     static rows ride each AOT artifact's meta (store
+#                     metadata, never a key axis) and are cross-checked
+#                     against Endpoint.resident_bytes() in tier-1.
+#
 #   9. ingest smoke — ISSUE 18: the streaming ingestion engine end to end
 #                     (tools/ingest_smoke.py): part-files through the
 #                     bounded reader pool must reproduce the in-memory
@@ -142,15 +167,15 @@ set -u
 cd "$(dirname "$0")/.."
 rc=0
 
-echo "== [1/9] jaxlint (AST + JL3xx concurrency + jaxpr + gang budgets + artifact manifest) =="
+echo "== [1/10] jaxlint (AST + JL3xx concurrency + jaxpr + gang budgets + artifact manifest) =="
 python -m tools.jaxlint || rc=1
 
-echo "== [2/9] jaxlint budget with telemetry + request tracing ON (zero drift) =="
+echo "== [2/10] jaxlint budget with telemetry + request tracing ON (zero drift) =="
 tele_dir="$(mktemp -d /tmp/_tele_gate.XXXXXX)"
 HARP_TELEMETRY_DIR="$tele_dir" HARP_TRACE_REQUESTS=1 \
     python -m tools.jaxlint --jaxpr-only || rc=1
 
-echo "== [3/9] gang-mode collective budgets (virtual multi-process mesh) =="
+echo "== [3/10] gang-mode collective budgets (virtual multi-process mesh) =="
 # ISSUE 13: the dryrun_multichip gang-mode step programs traced on the
 # virtual 2-host x 4-device mesh with the workers axis hinted DCN —
 # counts, per-process shard shapes, and the DCN/ICI link-class byte split
@@ -161,10 +186,10 @@ echo "== [3/9] gang-mode collective budgets (virtual multi-process mesh) =="
 # its own stage banner in CI output instead of buried in stage 1's.
 python -m tools.jaxlint --gang-only || rc=1
 
-echo "== [4/9] check_claims =="
+echo "== [4/10] check_claims =="
 python tools/check_claims.py || rc=1
 
-echo "== [5/9] tier-1 tests =="
+echo "== [5/10] tier-1 tests =="
 set -o pipefail
 t1_log="$(mktemp /tmp/_t1.XXXXXX.log)"   # unique per run: concurrent CI
 trap 'rm -f "$t1_log"; rm -rf "$tele_dir"' EXIT   # must not clobber the count
@@ -174,19 +199,26 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$t1_log" \
     | tr -cd . | wc -c)"
 
-echo "== [6/9] serving-chaos smoke (scripted kill under load, zero failures) =="
+echo "== [6/10] serving-chaos smoke (scripted kill under load, zero failures) =="
 # bounded like stage 5: a wedged recovery (the exact machinery this smoke
 # exercises) must fail CI, never hang it
 timeout -k 10 300 python -m tools.serving_chaos_smoke || rc=1
 
-echo "== [7/9] aot artifact round-trip (export -> hash-check -> load -> parity) =="
+echo "== [7/10] aot artifact round-trip (export -> hash-check -> load -> parity) =="
 timeout -k 10 300 python -m tools.aot_roundtrip_smoke || rc=1
 
-echo "== [8/9] overload + network chaos smoke (QPS ramp + netdrop + kill, autoscale up/down, zero failures) =="
+echo "== [8/10] overload + network chaos smoke (QPS ramp + netdrop + kill, autoscale up/down, zero failures) =="
 timeout -k 10 300 python -m tools.overload_chaos_smoke || rc=1
 
-echo "== [9/9] streaming-ingestion smoke (chunk stream, stream-vs-memory bitwise fit, device COO regroup) =="
+echo "== [9/10] streaming-ingestion smoke (chunk stream, stream-vs-memory bitwise fit, device COO regroup) =="
 timeout -k 10 300 python -m tools.ingest_smoke || rc=1
+
+echo "== [10/10] static memory budgets (JL4xx: liveness rows vs manifest, donation audit, const bloat, transient blowup) =="
+# ISSUE 19: stages 1-2 already run the memory engine inside their full/
+# telemetry passes; this dedicated pass (analysis over cached traces,
+# seconds) exists so a memory-budget failure is attributable to its own
+# stage banner in CI output instead of buried in stage 1's.
+python -m tools.jaxlint --memory-only || rc=1
 
 if [ "$rc" -ne 0 ]; then
     echo "ci_checks: FAILED"

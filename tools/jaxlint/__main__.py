@@ -40,6 +40,14 @@ def main(argv=None) -> int:
                              "gang-budget stage: virtual multi-process "
                              "mesh, counts/kinds/link-class bytes vs the "
                              "manifest)")
+    parser.add_argument("--memory-only", action="store_true",
+                        help="run ONLY the static memory engine (JL4xx, "
+                             "ISSUE 19): liveness rows vs the manifest's "
+                             "memory section (JL401), the donation audit "
+                             "(JL402), constant-capture bloat (JL403), "
+                             "and transient blowup (JL404) over BOTH "
+                             "trace registries — the CI memory-budget "
+                             "stage")
     parser.add_argument("--update-budget", action="store_true",
                         help="retrace all targets (both engines) and "
                              "rewrite tools/collective_budget.json")
@@ -71,6 +79,14 @@ def main(argv=None) -> int:
     if args.gang_only and args.update_budget:
         parser.error("--update-budget retraces BOTH registries so the "
                      "manifest stays whole; drop --gang-only")
+    if args.memory_only and (args.ast_only or args.jaxpr_only
+                             or args.gang_only or args.artifacts_only):
+        parser.error("--memory-only excludes the other engine selectors "
+                     "(it runs exactly one engine already)")
+    if args.memory_only and args.update_budget:
+        parser.error("--update-budget retraces BOTH registries and "
+                     "rewrites every manifest section together; drop "
+                     "--memory-only")
     if args.artifacts_only and (args.ast_only or args.jaxpr_only
                                 or args.gang_only):
         parser.error("--artifacts-only excludes the other engine "
@@ -120,9 +136,19 @@ def main(argv=None) -> int:
         out_note(f"allowlist schema: {e}", code="allowlist-schema")
     problems += len(schema_errors)
 
-    if not (args.jaxpr_only or args.gang_only or args.artifacts_only):
+    # the allowlist is one schema but two pools: JL4xx keys belong to the
+    # memory engine's traced findings (keyed on the budget file + target),
+    # everything else to the AST/concurrency engines — each pass applies
+    # only its own pool so the other pool's entries don't report stale
+    ast_allow = {k: v for k, v in ALLOWLIST.items()
+                 if not k[2].startswith("JL4")}
+    mem_allow = {k: v for k, v in ALLOWLIST.items()
+                 if k[2].startswith("JL4")}
+
+    if not (args.jaxpr_only or args.gang_only or args.artifacts_only
+            or args.memory_only):
         raw = run_ast_checkers(root, ast_checkers_for_repo(root))
-        active, stale = apply_allowlist(raw, ALLOWLIST)
+        active, stale = apply_allowlist(raw, ast_allow)
         active_keys = {id(f) for f in active}
         for f in raw:
             out_finding(f, allowlisted=id(f) not in active_keys)
@@ -132,7 +158,7 @@ def main(argv=None) -> int:
         status(f"ast engine: {len(active)} finding(s), {len(stale)} stale "
                f"allowlist entr(ies)")
 
-    if not (args.ast_only or args.artifacts_only):
+    if not (args.ast_only or args.artifacts_only or args.memory_only):
         from tools.jaxlint import checkers_jaxpr
 
         traced = None
@@ -140,9 +166,14 @@ def main(argv=None) -> int:
             traced = checkers_jaxpr.trace_all()
         gang = checkers_jaxpr.trace_gang_all()
         if args.update_budget:
-            path = checkers_jaxpr.write_budget(root, traced, gang)
+            from tools.jaxlint import checkers_memory
+
+            mem_rows = checkers_memory.trace_memory_all()
+            path = checkers_jaxpr.write_budget(root, traced, gang,
+                                               mem_rows)
             status(f"wrote {os.path.relpath(path, root)} "
-                   f"({len(traced)} targets, {len(gang)} gang targets)")
+                   f"({len(traced)} targets, {len(gang)} gang targets, "
+                   f"{len(mem_rows)} memory rows)")
         if traced is not None:
             budget_findings = checkers_jaxpr.check_budget(root, traced)
             for f in budget_findings:
@@ -157,13 +188,41 @@ def main(argv=None) -> int:
         status(f"gang engine: {len(gang)} gang-mode targets traced, "
                f"{len(gang_findings)} finding(s)")
 
+    # the static memory engine (JL4xx, ISSUE 19): liveness rows vs the
+    # manifest's memory section, donation audit, constant bloat, transient
+    # blowup — over BOTH registries. Runs in the full default pass, under
+    # --jaxpr-only (the telemetry gate re-checks memory rows too — the
+    # traces are cached, so this costs analysis only), and as its own
+    # --memory-only stage. JL401 drift is never suppressible (like
+    # JL201/JL203); JL402-404 ride the allowlist contract.
+    if not (args.ast_only or args.gang_only or args.artifacts_only):
+        from tools.jaxlint import checkers_memory
+
+        mem = checkers_memory.trace_memory_all()
+        mem_findings = checkers_memory.check_memory_budget(root, mem)
+        for f in mem_findings:
+            out_finding(f, allowlisted=False)
+        problems += len(mem_findings)
+        hazards = checkers_memory.check_memory_hazards()
+        h_active, h_stale = apply_allowlist(hazards, mem_allow)
+        h_active_ids = {id(f) for f in h_active}
+        for f in hazards:
+            out_finding(f, allowlisted=id(f) not in h_active_ids)
+        for s in h_stale:
+            out_note(s)
+        problems += len(h_active) + len(h_stale)
+        status(f"memory engine: {len(mem)} targets analyzed, "
+               f"{len(mem_findings) + len(h_active)} finding(s), "
+               f"{len(h_stale)} stale allowlist entr(ies)")
+
     # the compiled-program manifest (ISSUE 15): re-export the AOT registry
     # and hash-diff against tools/artifact_manifest.json — runs in the
     # full default pass and under --artifacts-only (the telemetry and
     # gang stages re-trace enough already; a program drift shows up here
     # regardless of which stage's pass caught it first)
     if args.artifacts_only or args.update_artifacts or not (
-            args.ast_only or args.jaxpr_only or args.gang_only):
+            args.ast_only or args.jaxpr_only or args.gang_only
+            or args.memory_only):
         import shutil
         import tempfile
 

@@ -125,6 +125,40 @@ def _walk(jaxpr, counts: Dict[str, int], dtype_bad: List[str],
             _walk(sub, counts, dtype_bad, nbytes)
 
 
+# One make_jaxpr per (registry, target) per process: the collective
+# engines, the gang engine, AND the memory engine (checkers_memory, ISSUE
+# 19) all analyze the same traced program, so the trace itself is cached —
+# the memory pass costs no extra tracing when it follows a budget pass.
+# Values are (ClosedJaxpr, placed args, workers-axis link class at trace
+# time); tier-1 shapes keep the held arrays tiny.
+_TRACE_CACHE: Dict[Tuple[str, str], tuple] = {}
+
+
+def traced_target(name: str, gang: bool = False) -> tuple:
+    """The cached ``(closed_jaxpr, args, link_class)`` of one registry
+    target, tracing it on first use (gang targets trace under the DCN
+    hint — see :func:`trace_gang_target`)."""
+    key = ("gang" if gang else "single", name)
+    if key not in _TRACE_CACHE:
+        import jax
+
+        from tools.jaxlint import trace_targets
+
+        if gang:
+            from harp_tpu.parallel import mesh as mesh_lib
+
+            with _gang_link_hint("dcn"):
+                fn, args = trace_targets.GANG_TARGETS[name]()
+                closed = jax.make_jaxpr(fn)(*args)
+                link = mesh_lib.axis_link_class(mesh_lib.WORKERS)
+        else:
+            fn, args = trace_targets.TARGETS[name]()
+            closed = jax.make_jaxpr(fn)(*args)
+            link = None
+        _TRACE_CACHE[key] = (closed, args, link)
+    return _TRACE_CACHE[key]
+
+
 def trace_target(name: str) -> Tuple[Dict[str, int], List[str],
                                      Dict[str, int]]:
     """Trace one registry target; returns (collective counts, dtype issues,
@@ -135,12 +169,7 @@ def trace_target(name: str) -> Tuple[Dict[str, int], List[str],
     the scan body counts once — i.e. the manifest records collectives **per
     step**, not per run (iteration counts are config, not contract).
     """
-    import jax
-
-    from tools.jaxlint import trace_targets
-
-    fn, args = trace_targets.TARGETS[name]()
-    closed = jax.make_jaxpr(fn)(*args)
+    closed, _args, _link = traced_target(name)
     counts: Dict[str, int] = {}
     dtype_bad: List[str] = []
     nbytes: Dict[str, int] = {}
@@ -256,25 +285,19 @@ def trace_gang_target(name: str) -> dict:
     program — the gang row pins the program a real 2-host gang runs, not
     the single-pod one retitled.
     """
-    import jax
-
-    from harp_tpu.parallel import mesh as mesh_lib
     from tools.jaxlint import trace_targets
 
     P = trace_targets.GANG_PROCESSES
     D = trace_targets.GANG_DEVICES_PER_PROCESS
-    with _gang_link_hint("dcn"):
-        fn, args = trace_targets.GANG_TARGETS[name]()
-        closed = jax.make_jaxpr(fn)(*args)
-        counts: Dict[str, int] = {}
-        dtype_bad: List[str] = []
-        nbytes: Dict[str, int] = {}
-        _walk(closed.jaxpr, counts, dtype_bad, nbytes)
-        link = mesh_lib.axis_link_class(mesh_lib.WORKERS)
-        by_link = split_bytes_by_link(
-            nbytes, world=trace_targets.NUM_WORKERS, processes=P,
-            devices_per_process=D, link_class=link)
-        shard_shapes = per_process_shard_shapes(args, D)
+    closed, args, link = traced_target(name, gang=True)
+    counts: Dict[str, int] = {}
+    dtype_bad: List[str] = []
+    nbytes: Dict[str, int] = {}
+    _walk(closed.jaxpr, counts, dtype_bad, nbytes)
+    by_link = split_bytes_by_link(
+        nbytes, world=trace_targets.NUM_WORKERS, processes=P,
+        devices_per_process=D, link_class=link)
+    shard_shapes = per_process_shard_shapes(args, D)
     return {
         "processes": P,
         "devices_per_process": D,
@@ -304,11 +327,12 @@ def load_budget(repo_root: str) -> Optional[dict]:
         return json.load(f)
 
 
-def write_budget(repo_root: str, traced, gang=None) -> str:
+def write_budget(repo_root: str, traced, gang=None, memory=None) -> str:
     """Rewrite the manifest from ``traced`` (and ``gang``, the gang-mode
-    rows from :func:`trace_gang_all`; None carries the committed gang rows
-    forward unchanged so a single-engine regenerate can't silently drop
-    the gang contract)."""
+    rows from :func:`trace_gang_all`; ``memory``, the static memory rows
+    from ``checkers_memory.trace_memory_all``. None carries the committed
+    rows of that section forward unchanged so a single-engine regenerate
+    can't silently drop another engine's contract)."""
     import jax
 
     if gang is None:
@@ -318,6 +342,12 @@ def write_budget(repo_root: str, traced, gang=None) -> str:
         gang_rows = {name: {k: v for k, v in row.items()
                             if not k.startswith("_")}
                      for name, row in sorted(gang.items())}
+    if memory is None:
+        existing = load_budget(repo_root) or {}
+        memory_rows = existing.get("memory", {})
+    else:
+        memory_rows = {name: dict(row)
+                       for name, row in sorted(memory.items())}
     path = os.path.join(repo_root, BUDGET_FILE)
     doc = {
         "_contract": (
@@ -343,7 +373,15 @@ def write_budget(repo_root: str, traced, gang=None) -> str:
             "(bytes_by_kind split DCN vs ICI by the ring-edge/peer model "
             "in checkers_jaxpr.split_bytes_by_link; grown DCN bytes at "
             "fixed counts is the cross-pod regression single-process rows "
-            "cannot see, JL203)."),
+            "cannot see, JL203). memory pins the STATIC memory rows "
+            "(ISSUE 19, checkers_memory/static_memory): resident_arg_bytes "
+            "(input + closed-over-constant footprint), peak_live_bytes "
+            "(liveness peak over the traced program, sub-jaxprs "
+            "recursively), and transient_peak_ratio (peak/resident, "
+            "rounded) per target across BOTH registries — a grown peak is "
+            "a memory regression that otherwise ships invisibly until an "
+            "OOM on real HBM, and the resident rows are the model mall's "
+            "planning input (JL401)."),
         "traced_with_jax": jax.__version__,
         "targets": {
             name: {
@@ -354,6 +392,7 @@ def write_budget(repo_root: str, traced, gang=None) -> str:
             }
             for name, (counts, _bad, nbytes) in sorted(traced.items())},
         "gang_targets": gang_rows,
+        "memory": memory_rows,
     }
     with open(path, "w", encoding="utf-8") as f:
         json.dump(doc, f, indent=2, sort_keys=False)

@@ -1,0 +1,233 @@
+"""Static memory engine — the fourth jaxlint engine (JL4xx, ISSUE 19).
+
+Runs liveness analysis (harp_tpu.aot.static_memory) over the ALREADY
+traced jaxprs of both trace registries (checkers_jaxpr caches every
+``make_jaxpr`` result, so the memory pass costs no extra tracing when the
+collective engines ran first) and enforces:
+
+  JL401 memory-budget      per-target ``peak_live_bytes`` /
+                           ``resident_arg_bytes`` / ``transient_peak_ratio``
+                           pinned in the ``memory`` section of
+                           ``tools/collective_budget.json``. Drift fails CI
+                           exactly like byte-drift (JL203) does — a program
+                           whose static peak grows is a memory regression
+                           that would otherwise ship invisibly until an OOM
+                           on real HBM; regenerate deliberately with
+                           ``--update-budget`` and review the diff.
+  JL402 dropped-donation   a ``donate_argnums`` buffer that cannot alias
+                           ANY output of matching shape/dtype in the traced
+                           program. XLA drops such a donation with only a
+                           warning: the caller believes the buffer is
+                           reused, it is actually doubled. Every real hit
+                           is fixed or individually justified in the
+                           allowlist (keys ``(BUDGET_FILE, target,
+                           "JL402")``).
+  JL403 constant-bloat     a closed-over array above
+                           ``CONST_BLOAT_BYTES`` baked into the jaxpr as a
+                           constant — duplicated HBM per program plus a
+                           retrace hazard (a new closure constant is a new
+                           program; the JL103 cache idiom cannot help).
+  JL404 transient-blowup   the liveness peak exceeds
+                           ``TRANSIENT_BLOWUP_RATIO`` × the resident
+                           argument bytes — the static signature of an
+                           accidental full gather/broadcast
+                           materialization (the static twin of the reshard
+                           engine's chunk budget). The per-target RATIO is
+                           also pinned by JL401, so drift below the
+                           absolute guard still fails loudly.
+
+Static numbers double as the model mall's planning input: the AOT store
+records each artifact's row (``aot/store.py`` meta — metadata, never a key
+axis) and tier-1 cross-checks ``Endpoint.resident_bytes()`` against the
+static estimate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from tools.jaxlint.core import Finding
+
+BUDGET_FILE = os.path.join("tools", "collective_budget.json")
+
+# JL403: the largest closed-over constant any clean trace target carries
+# today is ZERO bytes (state is passed as placed arguments everywhere —
+# the endpoints/models resolve placement once and thread state explicitly,
+# exactly so programs don't capture tables). 64 KiB leaves room for
+# incidental lookup tables while still catching a factor table or
+# parameter tree silently riding a closure.
+CONST_BLOAT_BYTES = 64 * 1024
+
+# JL404: the largest clean transient ratio in either registry is ~13.5x
+# (kmeans_allreduce_int8 — dequantize-then-reduce widens the working set);
+# 20x clears every committed program with margin while a full-table
+# gather/broadcast at tier-1 shapes lands far above it. Drift BELOW this
+# absolute guard still fails loudly: JL401 pins each target's exact ratio.
+TRANSIENT_BLOWUP_RATIO = 20.0
+
+MEMORY_FIELDS = ("resident_arg_bytes", "peak_live_bytes",
+                 "transient_peak_ratio")
+
+
+def _emit(findings: List[Finding], code: str, checker: str, target: str,
+          msg: str) -> None:
+    findings.append(Finding(code=code, checker=checker, path=BUDGET_FILE,
+                            line=1, func=target, message=msg))
+
+
+# -- per-jaxpr hazard checks (also the fixture surface for tests) -----------
+
+
+def donation_findings(closed, target: str) -> List[Finding]:
+    """JL402 for one traced program (static_memory.dropped_donations)."""
+    from harp_tpu.aot import static_memory
+
+    findings: List[Finding] = []
+    for d in static_memory.dropped_donations(closed):
+        _emit(findings, "JL402", "dropped-donation", target,
+              f"donated buffer {d.aval} ({d.nbytes} B) in jit "
+              f"{d.jit_name!r} aliases NO output of matching shape/dtype — "
+              f"XLA drops the donation with only a warning, so the buffer "
+              f"the caller believes is reused is actually doubled; remove "
+              f"the donate_argnums entry (or return a matching-aval "
+              f"output), or justify it in the allowlist")
+    return findings
+
+
+def const_findings(closed, target: str) -> List[Finding]:
+    """JL403 for one traced program: closed-over constants above the
+    bloat threshold."""
+    from harp_tpu.aot import static_memory
+
+    findings: List[Finding] = []
+    for c in static_memory.captured_consts(closed):
+        if c.nbytes >= CONST_BLOAT_BYTES:
+            _emit(findings, "JL403", "constant-bloat", target,
+                  f"closed-over {c.dtype}{list(c.shape)} constant "
+                  f"({c.nbytes} B ≥ {CONST_BLOAT_BYTES} B) is baked into "
+                  f"the jaxpr — duplicated HBM per program and a retrace "
+                  f"hazard; pass it as a placed argument instead")
+    return findings
+
+
+def transient_findings(closed, target: str) -> List[Finding]:
+    """JL404 for one traced program: liveness peak vs resident args."""
+    from harp_tpu.aot import static_memory
+
+    findings: List[Finding] = []
+    res = static_memory.analyze_liveness(closed.jaxpr)
+    if (res.resident_arg_bytes > 0
+            and res.peak_live_bytes
+            > TRANSIENT_BLOWUP_RATIO * res.resident_arg_bytes):
+        ratio = res.peak_live_bytes / res.resident_arg_bytes
+        _emit(findings, "JL404", "transient-blowup", target,
+              f"liveness peak {res.peak_live_bytes} B is {ratio:.1f}x the "
+              f"{res.resident_arg_bytes} B resident argument set (limit "
+              f"{TRANSIENT_BLOWUP_RATIO:g}x), at eqn "
+              f"#{res.peak_eqn_index} ({res.peak_eqn_primitive}) — an "
+              f"accidental full gather/broadcast materialization; chunk "
+              f"the transfer (the reshard engine's bounded schedule) or "
+              f"raise the budget deliberately")
+    return findings
+
+
+def hazard_findings(closed, target: str) -> List[Finding]:
+    """JL402 + JL403 + JL404 for one traced program."""
+    return (donation_findings(closed, target)
+            + const_findings(closed, target)
+            + transient_findings(closed, target))
+
+
+# -- registry-wide pass ------------------------------------------------------
+
+
+def trace_memory_all() -> Dict[str, dict]:
+    """JL401 rows for EVERY target in both registries (single-process and
+    gang-mode), keyed by target name. Reuses checkers_jaxpr's trace cache:
+    when the collective engines already traced a target this re-analyzes
+    the cached jaxpr at zero trace cost."""
+    from tools.jaxlint import checkers_jaxpr, trace_targets
+
+    # the virtual mesh MUST exist before the harp_tpu package import below
+    # pulls jax in (same ordering contract as checkers_jaxpr)
+    trace_targets.ensure_cpu_mesh()
+    from harp_tpu.aot import static_memory
+
+    rows: Dict[str, dict] = {}
+    for name in sorted(trace_targets.TARGETS):
+        closed, _args, _link = checkers_jaxpr.traced_target(name)
+        rows[name] = static_memory.memory_row(closed)
+    for name in sorted(trace_targets.GANG_TARGETS):
+        closed, _args, _link = checkers_jaxpr.traced_target(name, gang=True)
+        rows[name] = static_memory.memory_row(closed)
+    return rows
+
+
+def check_memory_hazards() -> List[Finding]:
+    """JL402/JL403/JL404 over both registries (raw — the caller routes
+    these through the allowlist, unlike the JL401 manifest drift which is
+    never suppressible)."""
+    from tools.jaxlint import checkers_jaxpr, trace_targets
+
+    trace_targets.ensure_cpu_mesh()
+    findings: List[Finding] = []
+    for name in sorted(trace_targets.TARGETS):
+        closed, _args, _link = checkers_jaxpr.traced_target(name)
+        findings.extend(hazard_findings(closed, name))
+    for name in sorted(trace_targets.GANG_TARGETS):
+        closed, _args, _link = checkers_jaxpr.traced_target(name, gang=True)
+        findings.extend(hazard_findings(closed, name))
+    return findings
+
+
+def load_memory_rows(repo_root: str) -> Optional[Dict[str, dict]]:
+    path = os.path.join(repo_root, BUDGET_FILE)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.load(f).get("memory")
+
+
+def check_memory_budget(repo_root: str,
+                        mem: Optional[Dict[str, dict]] = None
+                        ) -> List[Finding]:
+    """JL401: the traced memory rows vs the manifest's ``memory`` section
+    — exact equality per field, stale/missing rows loud (the check_budget
+    contract applied to HBM instead of the wire)."""
+    if mem is None:
+        mem = trace_memory_all()
+    findings: List[Finding] = []
+    pinned_rows = load_memory_rows(repo_root)
+    if pinned_rows is None:
+        _emit(findings, "JL401", "memory-budget", "<manifest>",
+              f"{BUDGET_FILE} has no memory section but {len(mem)} targets "
+              f"trace — regenerate with `python -m tools.jaxlint "
+              f"--update-budget` and commit the memory rows")
+        return findings
+    for name, row in sorted(mem.items()):
+        if name not in pinned_rows:
+            _emit(findings, "JL401", "memory-budget", name,
+                  f"traced target {name!r} has no memory row — run "
+                  f"--update-budget and review the new row")
+            continue
+        pinned = pinned_rows[name]
+        drift = []
+        for field in MEMORY_FIELDS:
+            got, want = row.get(field), pinned.get(field)
+            if got != want:
+                drift.append(f"{field}: traced {got} vs pinned {want}")
+        if drift:
+            _emit(findings, "JL401", "memory-budget", name,
+                  f"static memory-budget drift ({'; '.join(drift)}) — the "
+                  f"program's HBM footprint moved at tier-1 shapes (a "
+                  f"grown peak is the OOM that ships invisibly; a grown "
+                  f"resident set changes what the model mall can "
+                  f"co-locate); if intentional, --update-budget and "
+                  f"review the diff")
+    for name in sorted(set(pinned_rows) - set(mem)):
+        _emit(findings, "JL401", "memory-budget", name,
+              f"memory row {name!r} matches no trace target — stale row "
+              f"(target renamed/removed); regenerate with --update-budget")
+    return findings
