@@ -27,7 +27,7 @@ program whose device rate is ~93M (profiler-verified, PERF.md r5). Each
 two-point sample is a median of N≥3 alternating runs and ships a spread
 column; deltas inside the spread are noise by the data, not by prose.
 
-Usage: python bench.py [--small] [--only group1,group2,...]
+Usage: python bench.py [--small] [--only group1,group2,...] [--list-groups]
 
 ``--only`` re-measures a subset of row groups (names in ROW_GROUPS) without
 the full ~all-rows run and MERGES the result into BENCH_local.json instead
@@ -1217,6 +1217,13 @@ ROW_GROUPS = ("kmeans", "kmeans_padded128", "kmeans_csr", "sgd_mf", "als",
 
 def main():
     argv = sys.argv[1:]
+    if "--list-groups" in argv:
+        # the discoverable twin of --only's validator: one group name per
+        # line, nothing else — `bench.py --only "$(bench.py --list-groups
+        # | ...)"` composes, and tier-1 pins this list to ROW_GROUPS
+        for g in ROW_GROUPS:
+            print(g)
+        sys.exit(0)
     small = "--small" in argv
     only = None
     for i, a in enumerate(argv):

@@ -89,7 +89,7 @@ def export_endpoint(store: ArtifactStore, ep, *,
     in every cold worker). Returns ``{bucket: meta}``."""
     import jax
 
-    from harp_tpu.aot import static_memory
+    from harp_tpu.aot import hlo_audit, static_memory
 
     out = {}
     for bucket in (ep.bucket_sizes if buckets is None else buckets):
@@ -97,10 +97,14 @@ def export_endpoint(store: ArtifactStore, ep, *,
         args = ep.dispatch_args(bucket)
         # the static memory row rides along as placement metadata (never
         # a key axis): the mall reads resident/peak bytes off the meta
-        # without deserializing the program
+        # without deserializing the program. The compiled-HLO cost row
+        # (ISSUE 20) rides the same way — what the partitioner actually
+        # emits for this dispatch, readable without deserializing
         mem = static_memory.memory_row(jax.make_jaxpr(fn)(*args))
+        hlo = hlo_audit.hlo_row_for(fn, args)
         out[bucket] = store.export_and_put(
-            _key(ep, bucket, args, model_hash), fn, args, memory=mem)
+            _key(ep, bucket, args, model_hash), fn, args, memory=mem,
+            hlo=hlo)
     return out
 
 

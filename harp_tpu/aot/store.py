@@ -218,14 +218,18 @@ class ArtifactStore:
     # -- put/load -----------------------------------------------------------
 
     def put(self, key: ArtifactKey, payload: bytes, content_hash: str,
-            fmt: str, memory: Optional[dict] = None) -> dict:
+            fmt: str, memory: Optional[dict] = None,
+            hlo: Optional[dict] = None) -> dict:
         """Write one artifact atomically; returns the meta written.
 
         ``memory`` is the program's static memory row
         (``harp_tpu.aot.static_memory.memory_row``:
-        resident_arg_bytes / peak_live_bytes / transient_peak_ratio) —
-        recorded as METADATA for mall placement planning, never a key
-        axis: a differing or absent row must not turn a load into a miss
+        resident_arg_bytes / peak_live_bytes / transient_peak_ratio) and
+        ``hlo`` its compiled-HLO cost row
+        (``harp_tpu.aot.hlo_audit.hlo_row``: compiler-emitted collective
+        counts/bytes, instruction count, while count) — both recorded as
+        METADATA (placement planning / fleet tooling), never a key axis:
+        a differing or absent row must not turn a load into a miss
         (``load_meta`` checks only ``KEY_AXES``)."""
         meta_path, bin_path = self._paths(key.name)
         os.makedirs(os.path.dirname(meta_path) or ".", exist_ok=True)
@@ -235,6 +239,8 @@ class ArtifactStore:
                 "payload_sha256": hashlib.sha256(payload).hexdigest()}
         if memory is not None:
             meta["memory"] = dict(memory)
+        if hlo is not None:
+            meta["hlo"] = dict(hlo)
         tmp = bin_path + f".tmp-{os.getpid()}"
         with open(tmp, "wb") as f:
             f.write(payload)
@@ -247,9 +253,11 @@ class ArtifactStore:
         return meta
 
     def export_and_put(self, key: ArtifactKey, fn: Callable, args,
-                       memory: Optional[dict] = None) -> dict:
+                       memory: Optional[dict] = None,
+                       hlo: Optional[dict] = None) -> dict:
         payload, content_hash, fmt = self.export_fn(fn, args)
-        return self.put(key, payload, content_hash, fmt, memory=memory)
+        return self.put(key, payload, content_hash, fmt, memory=memory,
+                        hlo=hlo)
 
     def _miss(self, key: ArtifactKey, reason: str, detail: str) -> None:
         # LOUD by contract: the metric names the axis, the log names both

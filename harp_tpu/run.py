@@ -1281,6 +1281,11 @@ def run_aot(argv) -> int:
     p.add_argument("--compile-cache-dir", default="",
                    help="also populate the persistent compilation cache "
                         "while warming")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="ls: one JSON object per artifact (machine-"
+                        "readable rows incl. the memory and hlo meta, "
+                        "null-safe for artifacts exported before either "
+                        "row existed) instead of the table")
     args = p.parse_args(argv)
     import json as json_mod
 
@@ -1300,6 +1305,28 @@ def run_aot(argv) -> int:
             # static memory row (resident/peak HBM bytes, ISSUE 19) is
             # optional metadata, so its columns degrade the same way
             mem = meta.get("memory") or {}
+            if args.as_json:
+                # the stable machine row fleet tooling consumes instead
+                # of screen-scraping the table: key axes + sizes, the
+                # r20 res/peak columns, and the r21 hlo row — absent
+                # meta (pre-r20/r21 artifacts) serializes as null, never
+                # a missing key
+                print(json_mod.dumps({
+                    "name": meta.get("name"),
+                    "format": meta.get("format"),
+                    "world": meta.get("world"),
+                    "device_kind": meta.get("device_kind"),
+                    "jax_version": meta.get("jax_version"),
+                    "quant": meta.get("quant"),
+                    "payload_bytes": meta.get("payload_bytes"),
+                    "content_hash": meta.get("content_hash"),
+                    "resident_arg_bytes": mem.get("resident_arg_bytes"),
+                    "peak_live_bytes": mem.get("peak_live_bytes"),
+                    "transient_peak_ratio": mem.get(
+                        "transient_peak_ratio"),
+                    "hlo": meta.get("hlo"),
+                }, sort_keys=False))
+                continue
             resident = mem.get("resident_arg_bytes")
             peak = mem.get("peak_live_bytes")
             mem_col = (f"res={int(resident):>8d} B peak={int(peak):>8d} B"

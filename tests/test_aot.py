@@ -453,3 +453,109 @@ def test_aot_ls_prints_resident_and_peak_bytes(session, rng, tmp_path,
     assert run_aot(["ls", "--aot-dir", alt]) == 0
     out = capsys.readouterr().out
     assert "res=       ? B peak=       ? B" in out
+
+
+# --------------------------------------------------------------------------- #
+# Compiled-HLO rows in artifact meta (ISSUE 20): metadata, never a key axis
+# --------------------------------------------------------------------------- #
+
+def test_export_records_hlo_row_in_meta(session, rng, tmp_path):
+    from harp_tpu.aot.store import KEY_AXES
+
+    _m, store = _metrics_store(tmp_path)
+    ep, _uf, _items = _topk(session, rng)
+    metas = serve_artifacts.export_endpoint(store, ep, model_hash="h")
+    assert metas, metas
+    for meta in metas.values():
+        hlo = meta["hlo"]
+        assert hlo["instruction_count"] > 0
+        assert set(hlo["collectives"]) == set(hlo["collective_bytes"])
+        assert hlo["collective_bytes_total"] == sum(
+            hlo["collective_bytes"].values())
+        assert hlo["while_count"] >= 0
+        # the top-k dispatch routes through the keyval all_to_alls — the
+        # compiled row must show the partitioner kept them collective
+        assert hlo["collectives"].get("all-to-all", 0) >= 1, hlo
+    # the row is fleet-tooling METADATA: the key matrix is unchanged, so
+    # an hlo field can never turn a load into a (or mask a real) miss
+    assert KEY_AXES == ("jax_version", "device_kind", "world", "quant",
+                        "layout", "model_hash")
+    assert "hlo" not in KEY_AXES
+
+
+def test_hlo_row_mismatch_or_absence_never_misses(session, rng, tmp_path):
+    # a doctored (or stripped — pre-r21 store) hlo row must NOT reject
+    # the artifact: only KEY_AXES decide hit vs miss
+    m, store = _metrics_store(tmp_path)
+    ep, _uf, _items = _topk(session, rng)
+    serve_artifacts.export_endpoint(store, ep, model_hash="h")
+    name = serve_artifacts.dispatch_name("mf", 8)
+    _doctor_meta(store, name,
+                 hlo={"collectives": {"all-gather": 99},
+                      "collective_bytes": {"all-gather": 1},
+                      "collective_bytes_total": 1,
+                      "instruction_count": 1, "while_count": 0})
+    twin, _, _ = _topk(session, rng)
+    loaded = serve_artifacts.load_endpoint(store, twin, model_hash="h",
+                                           warm=False)
+    assert loaded == [8], loaded
+    # strip the row entirely: still a hit
+    path = store._paths(name)[0]
+    with open(path) as f:
+        meta = json.load(f)
+    del meta["hlo"]
+    with open(path, "w") as f:
+        json.dump(meta, f)
+    twin2, _, _ = _topk(session, rng)
+    loaded = serve_artifacts.load_endpoint(store, twin2, model_hash="h",
+                                           warm=False)
+    assert loaded == [8], loaded
+    assert m.snapshot()["counters"]["aot.store.hit"] == 2
+
+
+def test_aot_ls_json_rows_are_machine_readable(session, rng, tmp_path,
+                                               capsys):
+    # `aot ls --json`: one JSON object per artifact with the key axes,
+    # the r20 res/peak columns, and the r21 hlo row — and a pre-r20/r21
+    # meta serializes those fields as null instead of crashing or
+    # dropping the key
+    from harp_tpu.run import run_aot
+
+    _m, store = _metrics_store(tmp_path)
+    ep, _uf, _items = _topk(session, rng)
+    metas = serve_artifacts.export_endpoint(store, ep, model_hash="h")
+    name = serve_artifacts.dispatch_name("mf", 8)
+    path = store._paths(name)[0]
+    with open(path) as f:
+        meta = json.load(f)
+    stripped = {k: v for k, v in meta.items()
+                if k not in ("memory", "hlo")}
+    alt = str(tmp_path / "store2")
+    store2 = ArtifactStore(alt)
+    os.makedirs(os.path.dirname(store2._paths(name)[0]), exist_ok=True)
+    with open(store2._paths(name)[0], "w") as f:
+        json.dump(stripped, f)
+    with open(store._paths(name)[1], "rb") as f:
+        payload = f.read()
+    with open(store2._paths(name)[1], "wb") as f:
+        f.write(payload)
+
+    assert run_aot(["ls", "--aot-dir", str(tmp_path / "store"),
+                    "--json"]) == 0
+    rows = [json.loads(line)
+            for line in capsys.readouterr().out.strip().splitlines()]
+    assert len(rows) == len(metas)
+    row = next(r for r in rows if r["name"] == name)
+    assert row["resident_arg_bytes"] == \
+        metas[8]["memory"]["resident_arg_bytes"]
+    assert row["peak_live_bytes"] == metas[8]["memory"]["peak_live_bytes"]
+    assert row["hlo"] == metas[8]["hlo"]
+    assert row["world"] == session.num_workers
+    assert row["content_hash"] == metas[8]["content_hash"]
+
+    assert run_aot(["ls", "--aot-dir", alt, "--json"]) == 0
+    rows = [json.loads(line)
+            for line in capsys.readouterr().out.strip().splitlines()]
+    assert rows[0]["hlo"] is None
+    assert rows[0]["resident_arg_bytes"] is None
+    assert rows[0]["peak_live_bytes"] is None

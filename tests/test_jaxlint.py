@@ -1035,3 +1035,404 @@ def test_memory_doctored_manifest_fails_jl401_in_json_stream(
     assert "drift" in hits[0]["message"]
     assert {"file", "line", "code", "checker", "func", "message",
             "allowlisted"} <= set(hits[0])
+
+
+# -- JL5xx lowered-HLO engine (ISSUE 20) -------------------------------------
+
+import pytest  # noqa: E402
+
+from harp_tpu.aot import hlo_audit  # noqa: E402
+from tools.jaxlint import checkers_hlo  # noqa: E402
+from tools.jaxlint.core import split_allowlist  # noqa: E402
+
+
+def _hlo_section():
+    with open(os.path.join(REPO, checkers_hlo.BUDGET_FILE)) as f:
+        return json.load(f)["hlo"]
+
+
+def _write_budget(tmp_path, doc):
+    (tmp_path / "tools").mkdir(exist_ok=True)
+    (tmp_path / "tools" / "collective_budget.json").write_text(
+        json.dumps(doc))
+
+
+# a minimal post-SPMD module in the shapes the parser consumes: a tuple-
+# result async all-reduce pair (books ONCE, at the -start), a while loop,
+# and per-device entry parameters
+_HLO_FIXTURE = """\
+HloModule fixture_spmd
+
+%body (p: (s32[], f32[8,2])) -> (s32[], f32[8,2]) {
+  %p = (s32[], f32[8,2]{1,0}) parameter(0)
+  %ars = (f32[8,2]{1,0}, f32[8,2]{1,0}) all-reduce-start(f32[8,2]{1,0} %x), to_apply=%add
+  %ard = (f32[8,2]{1,0}, f32[8,2]{1,0}) all-reduce-done((f32[8,2]{1,0}, f32[8,2]{1,0}) %ars)
+  ROOT %t = (s32[], f32[8,2]{1,0}) tuple(s32[] %i, f32[8,2]{1,0} %y)
+}
+
+ENTRY %main.9_spmd (param.1: f32[8,2], param.0: s32[]) -> (s32[], f32[8,2]) {
+  %param.0 = s32[] parameter(1)
+  %param.1 = f32[8,2]{1,0} parameter(0)
+  %init = (s32[], f32[8,2]{1,0}) tuple(s32[] %param.0, f32[8,2]{1,0} %param.1)
+  ROOT %w = (s32[], f32[8,2]{1,0}) while((s32[], f32[8,2]{1,0}) %init), condition=%cond, body=%body
+}
+"""
+
+
+def test_hlo_parser_shapes_collectives_and_while():
+    shapes = hlo_audit.parse_shapes("(f32[8,2]{1,0}, s32[], token[])")
+    assert [str(s) for s in shapes] == ["f32[8,2]", "s32[]"]
+    assert hlo_audit.shape_bytes("(f32[8,2]{1,0}, s32[])") == 64 + 4
+    assert hlo_audit.shape_bytes("bf16[4,4]") == 32
+    stats = hlo_audit.collective_stats(_HLO_FIXTURE)
+    # the -start books the op once; the -done is the same transfer
+    assert stats == {"all-reduce": {"count": 1, "bytes": 128,
+                                    "shapes": ["f32[8,2]+f32[8,2]"]}}
+    assert hlo_audit.while_count(_HLO_FIXTURE) == 1
+    row = hlo_audit.hlo_row(_HLO_FIXTURE)
+    assert row["collectives"] == {"all-reduce": 1}
+    assert row["collective_bytes_total"] == 128
+    assert row["while_count"] == 1
+    assert row["instruction_count"] == 7
+    # entry params surface per-DEVICE blocks, not argument order
+    assert sorted(str(s) for s in
+                  hlo_audit.entry_param_shapes(_HLO_FIXTURE)) == \
+        ["f32[8,2]", "s32[]"]
+
+
+def test_jl501_injected_compiler_allgather_and_clean_twin():
+    # the acceptance fixture: a compiler-side all-gather injected into a
+    # module whose trace only showed a psum fails JL501 loudly, naming
+    # the op, shape, and inferred cause
+    doctored = (
+        "HloModule fixture_spmd\n\n"
+        "ENTRY %main.1_spmd (param.0: f32[8,16]) -> f32[64,16] {\n"
+        "  %param.0 = f32[8,16]{1,0} parameter(0)\n"
+        "  %ar = f32[8,16]{1,0} all-reduce(f32[8,16]{1,0} %param.0)\n"
+        "  ROOT %ag = f32[64,16]{1,0} all-gather(f32[8,16]{1,0} %ar), "
+        "dimensions={0}\n"
+        "}\n")
+    findings = checkers_hlo.inserted_findings_from(
+        doctored, {"psum": 1}, "fixture")
+    assert [f.code for f in findings] == ["JL501"], findings
+    msg = findings[0].message
+    assert "all-gather" in msg and "f32[64,16]" in msg
+    assert "full-broadcast" in msg          # the inferred cause family
+    assert findings[0].func == "fixture"
+    # clean twin 1: the SAME module when the trace owned the gather
+    assert checkers_hlo.inserted_findings_from(
+        doctored, {"psum": 1, "all_gather": 1}, "fixture") == []
+    # clean twin 2: drop the injected op — a psum-only module is clean
+    clean = doctored.replace(
+        "  ROOT %ag = f32[64,16]{1,0} all-gather(f32[8,16]{1,0} %ar), "
+        "dimensions={0}\n", "")
+    assert checkers_hlo.inserted_findings_from(
+        clean, {"psum": 1}, "fixture") == []
+
+
+class _FakeSharded:
+    """A placed-array stand-in: shape/dtype/sharding is all the audit
+    reads off a leaf."""
+
+    class _S:
+        def __init__(self, shard):
+            self._shard = shard
+
+        def shard_shape(self, _global_shape):
+            return self._shard
+
+    def __init__(self, shape, shard):
+        self.shape = shape
+        self.dtype = np.dtype("float32")
+        self.sharding = self._S(shard)
+
+
+def test_jl503_replicated_where_sharded_and_clean_twin():
+    args = (_FakeSharded((64, 16), (8, 16)),)
+    # doctored: the partitioner compiled the declared-sharded operand at
+    # its GLOBAL shape — the silent full-replication signature
+    doctored = (
+        "ENTRY %main.1_spmd (param.0: f32[64,16]) -> f32[64,16] {\n"
+        "  %param.0 = f32[64,16]{1,0} parameter(0)\n"
+        "}\n")
+    findings = checkers_hlo.replicated_findings_from(doctored, args, "fx")
+    assert [f.code for f in findings] == ["JL503"], findings
+    assert "REPLICATED" in findings[0].message
+    assert "f32[64,16]" in findings[0].message
+    assert "f32[8,16]" in findings[0].message        # the declared block
+    # clean twin: compiled at the declared per-device block
+    clean = doctored.replace("f32[64,16]", "f32[8,16]")
+    assert checkers_hlo.replicated_findings_from(clean, args, "fx") == []
+    # conservative twin: a const-folded (dropped) param is NOT a finding
+    folded = "ENTRY %main.1_spmd () -> f32[] {\n}\n"
+    assert checkers_hlo.replicated_findings_from(folded, args, "fx") == []
+
+
+def test_hlo_manifest_pins_all_targets_and_dispatch_matrix():
+    from tools.jaxlint import trace_targets
+
+    section = _hlo_section()
+    rows = section["targets"]
+    expected = set(trace_targets.TARGETS) | set(trace_targets.GANG_TARGETS)
+    assert set(rows) == expected, sorted(expected ^ set(rows))
+    for name, row in rows.items():
+        assert set(checkers_hlo.HLO_FIELDS) <= set(row), name
+        assert row["instruction_count"] > 0, name
+        assert set(row["collectives"]) == set(row["collective_bytes"]), name
+        assert row["collective_bytes_total"] == sum(
+            row["collective_bytes"].values()), name
+        assert set(row["collectives"]) <= set(
+            hlo_audit.HLO_COLLECTIVE_OPS), name
+    # the quantized serving dispatch moves FEWER compiled collective
+    # bytes than its f32 twin at the same op count — the int8 wire story,
+    # now a compiled-layer number
+    assert (rows["serve_topk_mf_int8"]["collectives"]
+            == rows["serve_topk_mf"]["collectives"])
+    assert (rows["serve_topk_mf_int8"]["collective_bytes_total"]
+            < rows["serve_topk_mf"]["collective_bytes_total"])
+    # the device-kind matrix: cpu is always pinned, with all 6 serving
+    # dispatches; mf routes stay collective, nn dispatches stay local
+    matrix = section["device_kinds"]["cpu"]
+    assert set(matrix) == {f"serve/{m}/b{b}" for m in ("mf", "nn")
+                           for b in (8, 32, 128)}
+    for name, row in matrix.items():
+        if name.startswith("serve/mf/"):
+            assert row["collectives"].get("all-to-all", 0) >= 1, name
+        else:
+            assert row["collectives"] == {}, name
+    # the committed section self-checks clean
+    assert checkers_hlo.check_hlo_budget(REPO, dict(rows),
+                                         dict(matrix)) == []
+
+
+def test_jl502_doctored_missing_stale_and_env_rows_are_loud(tmp_path):
+    with open(os.path.join(REPO, checkers_hlo.BUDGET_FILE)) as f:
+        doc = json.load(f)
+    rows = doc["hlo"]["targets"]
+    matrix = doc["hlo"]["device_kinds"]["cpu"]
+
+    # the acceptance criterion: a doctored compiled row fails JL502
+    # loudly, and ONLY for the doctored target
+    doctored = copy.deepcopy(doc)
+    doctored["hlo"]["targets"]["kmeans_allreduce"][
+        "instruction_count"] += 7
+    _write_budget(tmp_path, doctored)
+    findings = checkers_hlo.check_hlo_budget(str(tmp_path), dict(rows),
+                                             dict(matrix))
+    assert [(f.code, f.func) for f in findings] == \
+        [("JL502", "kmeans_allreduce")], findings
+    assert "drift" in findings[0].message
+    assert "instruction_count" in findings[0].message
+
+    # a lowered target with no pinned row / a row whose target vanished
+    extra = dict(rows)
+    extra["new_workload"] = dict(rows["kmeans_allreduce"])
+    _write_budget(tmp_path, doc)
+    findings = checkers_hlo.check_hlo_budget(str(tmp_path), extra,
+                                             dict(matrix))
+    assert any(f.code == "JL502" and "no hlo row" in f.message
+               for f in findings)
+    short = dict(rows)
+    del short["kmeans_allreduce"]
+    findings = checkers_hlo.check_hlo_budget(str(tmp_path), short,
+                                             dict(matrix))
+    assert any(f.code == "JL502" and f.func == "kmeans_allreduce"
+               and "stale" in f.message for f in findings)
+
+    # a manifest missing the whole hlo section (pre-r21 checkout)
+    _write_budget(tmp_path, {"targets": {}})
+    findings = checkers_hlo.check_hlo_budget(str(tmp_path), dict(rows),
+                                             dict(matrix))
+    assert [f.code for f in findings] == ["JL502"], findings
+    assert "no hlo section" in findings[0].message
+
+    # a different jax version re-pins with ONE finding, not N drifts
+    repinned = copy.deepcopy(doc)
+    repinned["hlo"]["lowered_with_jax"] = "0.0.1"
+    repinned["hlo"]["targets"]["kmeans_allreduce"][
+        "instruction_count"] += 7
+    _write_budget(tmp_path, repinned)
+    findings = checkers_hlo.check_hlo_budget(str(tmp_path), dict(rows),
+                                             dict(matrix))
+    assert len(findings) == 1 and "re-pin" in findings[0].message, findings
+
+
+def test_jl504_doctored_device_kind_rows_are_loud(tmp_path):
+    with open(os.path.join(REPO, checkers_hlo.BUDGET_FILE)) as f:
+        doc = json.load(f)
+    rows = doc["hlo"]["targets"]
+    matrix = doc["hlo"]["device_kinds"]["cpu"]
+
+    # the acceptance criterion: a doctored device-kind row fails JL504
+    # loudly, naming the dispatch and the kind
+    doctored = copy.deepcopy(doc)
+    doctored["hlo"]["device_kinds"]["cpu"]["serve/mf/b8"][
+        "collective_bytes_total"] += 64
+    _write_budget(tmp_path, doctored)
+    findings = checkers_hlo.check_hlo_budget(str(tmp_path), dict(rows),
+                                             dict(matrix))
+    assert [(f.code, f.func) for f in findings] == \
+        [("JL504", "serve/mf/b8")], findings
+    assert "'cpu'" in findings[0].message
+    assert "kind-dependent" in findings[0].message
+
+    # a missing matrix for the RUNNING kind is loud
+    missing = copy.deepcopy(doc)
+    del missing["hlo"]["device_kinds"]["cpu"]
+    _write_budget(tmp_path, missing)
+    findings = checkers_hlo.check_hlo_budget(str(tmp_path), dict(rows),
+                                             dict(matrix))
+    assert [f.code for f in findings] == ["JL504"], findings
+    assert "no pinned serving-dispatch row matrix" in findings[0].message
+
+    # stale dispatch row under the running kind
+    stale = copy.deepcopy(doc)
+    stale["hlo"]["device_kinds"]["cpu"]["serve/mf/b999"] = \
+        dict(matrix["serve/mf/b8"])
+    _write_budget(tmp_path, stale)
+    findings = checkers_hlo.check_hlo_budget(str(tmp_path), dict(rows),
+                                             dict(matrix))
+    assert any(f.code == "JL504" and f.func == "serve/mf/b999"
+               and "stale" in f.message for f in findings)
+
+    # a pinned kind this process cannot reach is CARRIED, never stale:
+    # the TPU matrix a TPU run pinned must survive a cpu-only check
+    foreign = copy.deepcopy(doc)
+    foreign["hlo"]["device_kinds"]["TPU v99"] = {
+        "serve/mf/b8": dict(matrix["serve/mf/b8"])}
+    _write_budget(tmp_path, foreign)
+    assert checkers_hlo.check_hlo_budget(str(tmp_path), dict(rows),
+                                         dict(matrix)) == []
+
+
+def test_hlo_allowlist_pool_split_regression():
+    # one allowlist, one pool per engine: JL4xx -> memory, JL5xx -> hlo,
+    # everything else -> ast; disjoint and exhaustive
+    fake = {
+        ("a.py", "f", "JL101"): "x" * 20,
+        ("tools/collective_budget.json", "t", "JL402"): "y" * 20,
+        ("tools/collective_budget.json", "t2", "JL501"): "z" * 20,
+        ("tools/collective_budget.json", "t3", "JL503"): "w" * 20,
+    }
+    pools = split_allowlist(fake)
+    assert set(pools) == {"ast", "memory", "hlo"}
+    assert set(pools["ast"]) == {("a.py", "f", "JL101")}
+    assert set(pools["memory"]) == {
+        ("tools/collective_budget.json", "t", "JL402")}
+    assert set(pools["hlo"]) == {
+        ("tools/collective_budget.json", "t2", "JL501"),
+        ("tools/collective_budget.json", "t3", "JL503")}
+    merged = {}
+    for p in pools.values():
+        assert not set(merged) & set(p)          # disjoint
+        merged.update(p)
+    assert merged == fake                        # exhaustive
+
+    # the regression this split exists for: a JL5xx entry must NOT reach
+    # an AST-pool pass — there it matches no finding and would report
+    # stale, failing every non-hlo stage of CI
+    ast_findings = [Finding("JL101", "c", "a.py", 1, "f", "m")]
+    active, stale = apply_allowlist(ast_findings, pools["ast"])
+    assert active == [] and stale == []
+    # ...and in ITS pool it suppresses the matching finding
+    hlo_finding = Finding("JL501", "inserted-collective",
+                          "tools/collective_budget.json", 1, "t2", "m")
+    active, stale = apply_allowlist([hlo_finding], pools["hlo"])
+    assert active == []                       # suppressed in its own pool
+    assert len(stale) == 1 and "t3" in stale[0]   # unmatched JL503 entry
+    # the committed allowlist partitions cleanly too
+    committed = split_allowlist(ALLOWLIST)
+    committed_merged = {}
+    for p in committed.values():
+        committed_merged.update(p)
+    assert committed_merged == dict(ALLOWLIST)
+
+
+def test_hlo_relowered_rows_match_committed_manifest(session):
+    # the end-to-end gate: re-lowering every traced program (and the 6
+    # serving dispatches on this backend) reproduces the committed hlo
+    # section exactly, and the repo's own programs carry no JL501/JL503
+    # hazards (no compiler-inserted collective kinds, no silently
+    # replicated operands)
+    rows = checkers_hlo.trace_hlo_all()
+    kind_rows = checkers_hlo.serving_dispatch_rows()
+    findings = checkers_hlo.check_hlo_budget(REPO, rows, kind_rows)
+    assert findings == [], "\n".join(str(f) for f in findings)
+    assert len(rows) >= 32
+    assert len(kind_rows) == 6
+    assert checkers_hlo.check_hlo_hazards() == []
+
+
+def test_hlo_build_section_carries_unreachable_kinds(session, tmp_path):
+    # --update-budget on a cpu-only host must not DROP a TPU matrix a
+    # TPU run pinned: build_hlo_section refreshes the running kind and
+    # carries every other kind forward verbatim
+    with open(os.path.join(REPO, checkers_hlo.BUDGET_FILE)) as f:
+        doc = json.load(f)
+    foreign_row = {"serve/mf/b8":
+                   dict(doc["hlo"]["device_kinds"]["cpu"]["serve/mf/b8"])}
+    doctored = copy.deepcopy(doc)
+    doctored["hlo"]["device_kinds"]["TPU v99"] = foreign_row
+    _write_budget(tmp_path, doctored)
+    section = checkers_hlo.build_hlo_section(str(tmp_path))
+    assert section["device_kinds"]["TPU v99"] == foreign_row
+    assert set(section["device_kinds"]["cpu"]) == \
+        set(doc["hlo"]["device_kinds"]["cpu"])
+    assert section["targets"] == doc["hlo"]["targets"]
+
+
+def test_hlo_only_flag_runs_exactly_one_engine(session, capsys):
+    from tools.jaxlint.__main__ import main as jaxlint_main
+
+    rc = jaxlint_main(["--hlo-only"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "hlo engine:" in out
+    for banner in ("ast engine", "jaxpr engine", "gang engine",
+                   "memory engine", "artifact engine"):
+        assert banner not in out, out
+    # exactly-one-engine contract: combining selectors is a usage error
+    with pytest.raises(SystemExit):
+        jaxlint_main(["--hlo-only", "--memory-only"])
+    capsys.readouterr()
+    with pytest.raises(SystemExit):
+        jaxlint_main(["--hlo-only", "--update-budget"])
+    capsys.readouterr()
+
+
+def test_hlo_doctored_manifest_fails_jl502_in_json_stream(
+        session, tmp_path, capsys):
+    # end to end through the CLI: a doctored compiled-collective row in a
+    # copied manifest surfaces as a machine-readable JL502 record on the
+    # JSONL stream with the full record schema, and the exit goes nonzero
+    with open(os.path.join(REPO, checkers_hlo.BUDGET_FILE)) as f:
+        doc = json.load(f)
+    doc["hlo"]["targets"]["serve_topk_mf"]["collective_bytes"][
+        "all-to-all"] += 64
+    _write_budget(tmp_path, doc)
+    from tools.jaxlint.__main__ import main as jaxlint_main
+
+    rc = jaxlint_main([str(tmp_path), "--hlo-only", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    lines = [json.loads(line) for line in out.strip().splitlines()]
+    hits = [r for r in lines if r["code"] == "JL502"]
+    assert hits and hits[0]["func"] == "serve_topk_mf", out
+    assert hits[0]["allowlisted"] is False
+    assert "drift" in hits[0]["message"]
+    assert {"file", "line", "code", "checker", "func", "message",
+            "allowlisted"} <= set(hits[0])
+
+
+def test_bench_list_groups_matches_only_validator():
+    # the satellite contract: --list-groups prints EXACTLY the names the
+    # --only validator accepts, one per line
+    import subprocess
+
+    import bench
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--list-groups"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.split() == list(bench.ROW_GROUPS)

@@ -485,6 +485,66 @@ CLAIMS: List[Claim] = [
           r"\| ingest_coo_regroup \| (\S+) B",
           ("memory", "ingest_coo_regroup", "resident_arg_bytes"),
           rel_tol=0.0, file="tools/collective_budget.json"),
+    # PERF.md r21 (ISSUE 20): the compiled-collective table — per-target
+    # post-SPMD cost rows pinned to the manifest's `hlo` section (jaxlint
+    # JL502/JL504 keep the manifest honest against what the partitioner
+    # emits; these keep the PROSE honest against the manifest). Compiled
+    # rows are exact per jax version — zero tolerance; the op COUNTS are
+    # baked into the regex literals, so a changed count goes stale-loud
+    # instead of silently matching.
+    Claim("hlo_kmeans_bytes", "PERF.md",
+          r"\| kmeans_allreduce \| 2× all-reduce \| (\S+) B",
+          ("hlo", "targets", "kmeans_allreduce", "collective_bytes_total"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("hlo_kmeans_instrs", "PERF.md",
+          r"\| kmeans_allreduce \| 2× all-reduce \| \S+ B \| (\d+) \|",
+          ("hlo", "targets", "kmeans_allreduce", "instruction_count"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("hlo_topk_bytes", "PERF.md",
+          r"\| serve_topk_mf \| 3× all-to-all \| (\S+) B",
+          ("hlo", "targets", "serve_topk_mf", "collective_bytes_total"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("hlo_topk_int8_bytes", "PERF.md",
+          r"\| serve_topk_mf_int8 \| 3× all-to-all \| (\S+) B",
+          ("hlo", "targets", "serve_topk_mf_int8",
+           "collective_bytes_total"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("hlo_topk_int8_instrs", "PERF.md",
+          r"\| serve_topk_mf_int8 \| 3× all-to-all \| \S+ B \| (\d+) \|",
+          ("hlo", "targets", "serve_topk_mf_int8", "instruction_count"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("hlo_gang_rga_bytes", "PERF.md",
+          r"\| gang2x4_kmeans_regroupallgather \| AG 65536 \+ RS 8256 "
+          r"\+ AR 4 \| (\S+) B",
+          ("hlo", "targets", "gang2x4_kmeans_regroupallgather",
+           "collective_bytes_total"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("hlo_ingest_regroup_bytes", "PERF.md",
+          r"\| ingest_coo_regroup \| 1× all-to-all \| (\S+) B",
+          ("hlo", "targets", "ingest_coo_regroup",
+           "collective_bytes_total"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    # the device-kind dispatch matrix rows (JL504's pins, cpu kind)
+    Claim("hlo_dispatch_b8_bytes", "PERF.md",
+          r"\| serve/mf/b8 \| 3× all-to-all \| (\S+) B",
+          ("hlo", "device_kinds", "cpu", "serve/mf/b8",
+           "collective_bytes_total"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("hlo_dispatch_b32_bytes", "PERF.md",
+          r"\| serve/mf/b32 \| 3× all-to-all \| (\S+) B",
+          ("hlo", "device_kinds", "cpu", "serve/mf/b32",
+           "collective_bytes_total"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("hlo_dispatch_b128_bytes", "PERF.md",
+          r"\| serve/mf/b128 \| 3× all-to-all \| (\S+) B",
+          ("hlo", "device_kinds", "cpu", "serve/mf/b128",
+           "collective_bytes_total"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
+    Claim("hlo_dispatch_nn_b8_instrs", "PERF.md",
+          r"\| serve/nn/b8 \| none \| \S+ B \| (\d+) \|",
+          ("hlo", "device_kinds", "cpu", "serve/nn/b8",
+           "instruction_count"),
+          rel_tol=0.0, file="tools/collective_budget.json"),
 ]
 
 

@@ -160,6 +160,35 @@
 #                     distributed COO→CSR matching the per-block
 #                     counting-sort oracle exactly.
 #
+#   11. hlo gate   — ISSUE 20 (r21): the LOWERED-HLO engine (JL5xx,
+#                     tools/jaxlint/checkers_hlo.py) as its own
+#                     attributable stage: every cached trace target in
+#                     BOTH registries is compiled post-SPMD
+#                     (jax.jit(...).lower().compile() — compilation only,
+#                     nothing executes) and the optimized HLO is parsed
+#                     for what the PARTITIONER actually emitted. A
+#                     compiled collective kind no traced primitive maps
+#                     to is a JL501 finding (GSPMD inserted communication
+#                     after tracing — the layer every jaxpr-pinned byte
+#                     budget is blind to), per-target compiled cost rows
+#                     (collective counts + result bytes, instruction
+#                     count, while count) are pinned in the manifest's
+#                     `hlo` section (JL502 — drift fails exactly like
+#                     JL203), an operand declared sharded that compiled
+#                     REPLICATED is a JL503 finding (the static signature
+#                     of a silent full broadcast), and the 6 pinned
+#                     serving dispatches are lowered per reachable device
+#                     kind into the `device_kinds` matrix (JL504 — cpu in
+#                     CI; TPU kinds pin when lint runs there and are
+#                     carried forward, never stale, by CPU regenerates).
+#                     Stage 1 already runs the engine inside its full
+#                     pass; this pass gives compiled-contract failures
+#                     their own CI banner. The same hlo rows ride each
+#                     AOT artifact's meta (store metadata, never a key
+#                     axis), and stage 4 pins the PERF.md r21
+#                     compiled-collective table against the manifest at
+#                     tol 0.
+#
 # Any stage failing fails the script; all stages always run (a lint
 # finding must not hide a test regression or vice versa).
 
@@ -167,15 +196,15 @@ set -u
 cd "$(dirname "$0")/.."
 rc=0
 
-echo "== [1/10] jaxlint (AST + JL3xx concurrency + jaxpr + gang budgets + artifact manifest) =="
+echo "== [1/11] jaxlint (AST + JL3xx concurrency + jaxpr + gang budgets + artifact manifest) =="
 python -m tools.jaxlint || rc=1
 
-echo "== [2/10] jaxlint budget with telemetry + request tracing ON (zero drift) =="
+echo "== [2/11] jaxlint budget with telemetry + request tracing ON (zero drift) =="
 tele_dir="$(mktemp -d /tmp/_tele_gate.XXXXXX)"
 HARP_TELEMETRY_DIR="$tele_dir" HARP_TRACE_REQUESTS=1 \
     python -m tools.jaxlint --jaxpr-only || rc=1
 
-echo "== [3/10] gang-mode collective budgets (virtual multi-process mesh) =="
+echo "== [3/11] gang-mode collective budgets (virtual multi-process mesh) =="
 # ISSUE 13: the dryrun_multichip gang-mode step programs traced on the
 # virtual 2-host x 4-device mesh with the workers axis hinted DCN —
 # counts, per-process shard shapes, and the DCN/ICI link-class byte split
@@ -186,10 +215,10 @@ echo "== [3/10] gang-mode collective budgets (virtual multi-process mesh) =="
 # its own stage banner in CI output instead of buried in stage 1's.
 python -m tools.jaxlint --gang-only || rc=1
 
-echo "== [4/10] check_claims =="
+echo "== [4/11] check_claims =="
 python tools/check_claims.py || rc=1
 
-echo "== [5/10] tier-1 tests =="
+echo "== [5/11] tier-1 tests =="
 set -o pipefail
 t1_log="$(mktemp /tmp/_t1.XXXXXX.log)"   # unique per run: concurrent CI
 trap 'rm -f "$t1_log"; rm -rf "$tele_dir"' EXIT   # must not clobber the count
@@ -199,26 +228,33 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$t1_log" \
     | tr -cd . | wc -c)"
 
-echo "== [6/10] serving-chaos smoke (scripted kill under load, zero failures) =="
+echo "== [6/11] serving-chaos smoke (scripted kill under load, zero failures) =="
 # bounded like stage 5: a wedged recovery (the exact machinery this smoke
 # exercises) must fail CI, never hang it
 timeout -k 10 300 python -m tools.serving_chaos_smoke || rc=1
 
-echo "== [7/10] aot artifact round-trip (export -> hash-check -> load -> parity) =="
+echo "== [7/11] aot artifact round-trip (export -> hash-check -> load -> parity) =="
 timeout -k 10 300 python -m tools.aot_roundtrip_smoke || rc=1
 
-echo "== [8/10] overload + network chaos smoke (QPS ramp + netdrop + kill, autoscale up/down, zero failures) =="
+echo "== [8/11] overload + network chaos smoke (QPS ramp + netdrop + kill, autoscale up/down, zero failures) =="
 timeout -k 10 300 python -m tools.overload_chaos_smoke || rc=1
 
-echo "== [9/10] streaming-ingestion smoke (chunk stream, stream-vs-memory bitwise fit, device COO regroup) =="
+echo "== [9/11] streaming-ingestion smoke (chunk stream, stream-vs-memory bitwise fit, device COO regroup) =="
 timeout -k 10 300 python -m tools.ingest_smoke || rc=1
 
-echo "== [10/10] static memory budgets (JL4xx: liveness rows vs manifest, donation audit, const bloat, transient blowup) =="
+echo "== [10/11] static memory budgets (JL4xx: liveness rows vs manifest, donation audit, const bloat, transient blowup) =="
 # ISSUE 19: stages 1-2 already run the memory engine inside their full/
 # telemetry passes; this dedicated pass (analysis over cached traces,
 # seconds) exists so a memory-budget failure is attributable to its own
 # stage banner in CI output instead of buried in stage 1's.
 python -m tools.jaxlint --memory-only || rc=1
+
+echo "== [11/11] lowered-HLO gate (JL5xx: compiler-inserted collectives, pinned hlo rows, sharding propagation, device-kind matrix) =="
+# ISSUE 20: stage 1 already runs the hlo engine inside its full pass; this
+# dedicated pass (lowering over cached traces, ~30s) exists so a
+# compiled-contract failure is attributable to its own stage banner in CI
+# output instead of buried in stage 1's.
+python -m tools.jaxlint --hlo-only || rc=1
 
 if [ "$rc" -ne 0 ]; then
     echo "ci_checks: FAILED"

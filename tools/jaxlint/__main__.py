@@ -48,6 +48,15 @@ def main(argv=None) -> int:
                              "and transient blowup (JL404) over BOTH "
                              "trace registries — the CI memory-budget "
                              "stage")
+    parser.add_argument("--hlo-only", action="store_true",
+                        help="run ONLY the lowered-HLO engine (JL5xx, "
+                             "ISSUE 20): compile every cached trace "
+                             "target post-SPMD (no execution) and check "
+                             "compiler-inserted collectives (JL501), the "
+                             "pinned hlo cost rows (JL502), sharding "
+                             "propagation (JL503), and the per-device-"
+                             "kind serving-dispatch matrix (JL504) — the "
+                             "CI HLO gate")
     parser.add_argument("--update-budget", action="store_true",
                         help="retrace all targets (both engines) and "
                              "rewrite tools/collective_budget.json")
@@ -94,6 +103,15 @@ def main(argv=None) -> int:
     if args.artifacts_only and args.update_budget:
         parser.error("--update-budget needs the jaxpr engines; drop "
                      "--artifacts-only (or use --update-artifacts)")
+    if args.hlo_only and (args.ast_only or args.jaxpr_only
+                          or args.gang_only or args.memory_only
+                          or args.artifacts_only):
+        parser.error("--hlo-only excludes the other engine selectors "
+                     "(it runs exactly one engine already)")
+    if args.hlo_only and args.update_budget:
+        parser.error("--update-budget retraces BOTH registries and "
+                     "rewrites every manifest section together; drop "
+                     "--hlo-only")
 
     root = args.root or os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
@@ -136,17 +154,19 @@ def main(argv=None) -> int:
         out_note(f"allowlist schema: {e}", code="allowlist-schema")
     problems += len(schema_errors)
 
-    # the allowlist is one schema but two pools: JL4xx keys belong to the
-    # memory engine's traced findings (keyed on the budget file + target),
+    # the allowlist is one schema but one pool PER ENGINE (core.
+    # split_allowlist): JL4xx keys belong to the memory engine, JL5xx to
+    # the lowered-HLO engine (both keyed on the budget file + target),
     # everything else to the AST/concurrency engines — each pass applies
-    # only its own pool so the other pool's entries don't report stale
-    ast_allow = {k: v for k, v in ALLOWLIST.items()
-                 if not k[2].startswith("JL4")}
-    mem_allow = {k: v for k, v in ALLOWLIST.items()
-                 if k[2].startswith("JL4")}
+    # only its own pool so a cross-engine entry never reports stale
+    from tools.jaxlint.core import split_allowlist
+
+    pools = split_allowlist(ALLOWLIST)
+    ast_allow, mem_allow, hlo_allow = (pools["ast"], pools["memory"],
+                                       pools["hlo"])
 
     if not (args.jaxpr_only or args.gang_only or args.artifacts_only
-            or args.memory_only):
+            or args.memory_only or args.hlo_only):
         raw = run_ast_checkers(root, ast_checkers_for_repo(root))
         active, stale = apply_allowlist(raw, ast_allow)
         active_keys = {id(f) for f in active}
@@ -158,7 +178,8 @@ def main(argv=None) -> int:
         status(f"ast engine: {len(active)} finding(s), {len(stale)} stale "
                f"allowlist entr(ies)")
 
-    if not (args.ast_only or args.artifacts_only or args.memory_only):
+    if not (args.ast_only or args.artifacts_only or args.memory_only
+            or args.hlo_only):
         from tools.jaxlint import checkers_jaxpr
 
         traced = None
@@ -166,14 +187,16 @@ def main(argv=None) -> int:
             traced = checkers_jaxpr.trace_all()
         gang = checkers_jaxpr.trace_gang_all()
         if args.update_budget:
-            from tools.jaxlint import checkers_memory
+            from tools.jaxlint import checkers_hlo, checkers_memory
 
             mem_rows = checkers_memory.trace_memory_all()
+            hlo_section = checkers_hlo.build_hlo_section(root)
             path = checkers_jaxpr.write_budget(root, traced, gang,
-                                               mem_rows)
+                                               mem_rows, hlo_section)
             status(f"wrote {os.path.relpath(path, root)} "
                    f"({len(traced)} targets, {len(gang)} gang targets, "
-                   f"{len(mem_rows)} memory rows)")
+                   f"{len(mem_rows)} memory rows, "
+                   f"{len(hlo_section.get('targets', {}))} hlo rows)")
         if traced is not None:
             budget_findings = checkers_jaxpr.check_budget(root, traced)
             for f in budget_findings:
@@ -195,7 +218,8 @@ def main(argv=None) -> int:
     # traces are cached, so this costs analysis only), and as its own
     # --memory-only stage. JL401 drift is never suppressible (like
     # JL201/JL203); JL402-404 ride the allowlist contract.
-    if not (args.ast_only or args.gang_only or args.artifacts_only):
+    if not (args.ast_only or args.gang_only or args.artifacts_only
+            or args.hlo_only):
         from tools.jaxlint import checkers_memory
 
         mem = checkers_memory.trace_memory_all()
@@ -215,6 +239,40 @@ def main(argv=None) -> int:
                f"{len(mem_findings) + len(h_active)} finding(s), "
                f"{len(h_stale)} stale allowlist entr(ies)")
 
+    # the lowered-HLO engine (JL5xx, ISSUE 20): compile every cached
+    # trace target post-SPMD — compilation only, nothing executes — and
+    # check compiler-inserted collectives (JL501), the pinned compiled
+    # cost rows (JL502), sharding propagation (JL503), and the per-
+    # device-kind serving-dispatch matrix (JL504). Runs in the full
+    # default pass and as its own --hlo-only CI stage. JL502/JL504
+    # manifest drift is never suppressible; JL501/JL503 ride the JL5xx
+    # allowlist pool.
+    if args.hlo_only or not (args.ast_only or args.jaxpr_only
+                             or args.gang_only or args.memory_only
+                             or args.artifacts_only):
+        from tools.jaxlint import checkers_hlo
+
+        hlo_rows = checkers_hlo.trace_hlo_all()
+        kind_rows = checkers_hlo.serving_dispatch_rows()
+        hlo_findings = checkers_hlo.check_hlo_budget(root, hlo_rows,
+                                                     kind_rows)
+        for f in hlo_findings:
+            out_finding(f, allowlisted=False)
+        problems += len(hlo_findings)
+        hlo_hazards = checkers_hlo.check_hlo_hazards()
+        hz_active, hz_stale = apply_allowlist(hlo_hazards, hlo_allow)
+        hz_active_ids = {id(f) for f in hz_active}
+        for f in hlo_hazards:
+            out_finding(f, allowlisted=id(f) not in hz_active_ids)
+        for s in hz_stale:
+            out_note(s)
+        problems += len(hz_active) + len(hz_stale)
+        status(f"hlo engine: {len(hlo_rows)} targets lowered, "
+               f"{len(kind_rows)} serving dispatches on "
+               f"{checkers_hlo.running_device_kind()!r}, "
+               f"{len(hlo_findings) + len(hz_active)} finding(s), "
+               f"{len(hz_stale)} stale allowlist entr(ies)")
+
     # the compiled-program manifest (ISSUE 15): re-export the AOT registry
     # and hash-diff against tools/artifact_manifest.json — runs in the
     # full default pass and under --artifacts-only (the telemetry and
@@ -222,7 +280,7 @@ def main(argv=None) -> int:
     # regardless of which stage's pass caught it first)
     if args.artifacts_only or args.update_artifacts or not (
             args.ast_only or args.jaxpr_only or args.gang_only
-            or args.memory_only):
+            or args.memory_only or args.hlo_only):
         import shutil
         import tempfile
 

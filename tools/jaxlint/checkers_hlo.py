@@ -1,0 +1,354 @@
+"""Lowered-HLO engine — the fifth jaxlint engine (JL5xx, ISSUE 20).
+
+Lowers every ALREADY-traced registry target (checkers_jaxpr caches each
+``make_jaxpr`` result, so this engine adds compilation only — the programs
+are compiled through ``jax.jit(...).lower(...).compile()``, **never
+executed**) and audits the post-SPMD optimized HLO the partitioner
+actually emitted — the layer EQuARX (arXiv:2506.17615) shows decides real
+wire behavior, and the layer every jaxpr-pinned contract (JL2xx bytes,
+JL4xx memory) is blind to:
+
+  JL501 inserted-collective   a compiled collective KIND
+                              (``all-gather``/``all-reduce``/
+                              ``collective-permute``/``all-to-all``/
+                              ``reduce-scatter``) that NO traced jaxpr
+                              primitive of the target maps to — GSPMD
+                              added communication after tracing. The
+                              finding names the op, its result shapes,
+                              and the inferred insertion cause (the
+                              full-broadcast / partial-sum / reshard
+                              families). Real hits are fixed or
+                              individually justified in the allowlist,
+                              keys ``(BUDGET_FILE, target, "JL501")``.
+  JL502 hlo-budget            per-target compiled cost rows (collective
+                              op counts + result bytes, instruction
+                              count, while-body count) pinned in the
+                              ``hlo`` section of
+                              ``tools/collective_budget.json``. Exact
+                              equality; drift/missing/stale fail loudly
+                              like JL203; regenerate deliberately with
+                              ``--update-budget``. Rows are
+                              jax-version-pinned (``lowered_with_jax``):
+                              a different jax re-pins with ONE clear
+                              finding instead of N bogus drifts.
+  JL503 sharding-propagation  an operand DECLARED sharded that the
+                              partitioner compiled at its GLOBAL shape —
+                              the static signature of a silent full
+                              replication (every device holds the whole
+                              array; an all-gather usually rides the
+                              wire). Allowlist-routed like JL501.
+  JL504 device-kind-matrix    the 6 pinned serving dispatches
+                              (``serve/{mf,nn}/b{8,32,128}`` — the
+                              artifact-manifest registry) lowered on the
+                              RUNNING backend and pinned per
+                              ``device_kind`` (``cpu`` always in tier-1;
+                              TPU kinds land when lint runs with a TPU
+                              backend reachable). Pinned kinds the
+                              running process cannot reach are carried
+                              forward, never stale — a kind-dependent
+                              lowering regression is caught before the
+                              heterogeneous fleet ships.
+
+Parsing/lowering primitives live in ``harp_tpu.aot.hlo_audit`` (shared
+with the AOT store's per-artifact ``hlo`` meta rows).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from tools.jaxlint.core import Finding
+
+BUDGET_FILE = os.path.join("tools", "collective_budget.json")
+
+# the exact-equality fields of one pinned hlo row (JL502)
+HLO_FIELDS = ("collectives", "collective_bytes", "collective_bytes_total",
+              "instruction_count", "while_count")
+
+# compiled module text per (registry, target) per process — the lowering
+# twin of checkers_jaxpr._TRACE_CACHE: each target compiles once no matter
+# how many JL5xx passes ask for it
+_HLO_CACHE: Dict[Tuple[str, str], str] = {}
+
+# compiled module text per serving dispatch name (JL504)
+_DISPATCH_CACHE: Dict[str, str] = {}
+
+
+def _emit(findings: List[Finding], code: str, checker: str, target: str,
+          msg: str) -> None:
+    findings.append(Finding(code=code, checker=checker, path=BUDGET_FILE,
+                            line=1, func=target, message=msg))
+
+
+def lowered_target_text(name: str, gang: bool = False) -> str:
+    """The compiled post-SPMD module text of one registry target —
+    lowered from the cached trace (no re-trace, no execution)."""
+    key = ("gang" if gang else "single", name)
+    if key not in _HLO_CACHE:
+        from harp_tpu.aot import hlo_audit
+        from tools.jaxlint import checkers_jaxpr
+
+        closed, args, _link = checkers_jaxpr.traced_target(name, gang=gang)
+        _HLO_CACHE[key] = hlo_audit.compiled_text(
+            hlo_audit.lower_closed(closed, args))
+    return _HLO_CACHE[key]
+
+
+def _jaxpr_collective_counts(closed) -> Dict[str, int]:
+    from tools.jaxlint import checkers_jaxpr
+
+    counts: Dict[str, int] = {}
+    checkers_jaxpr._walk(closed.jaxpr, counts, [], {})
+    return counts
+
+
+# -- per-module checks (also the doctored-fixture surface for tests) --------
+
+
+def inserted_findings_from(hlo_text: str, jaxpr_counts: Dict[str, int],
+                           target: str) -> List[Finding]:
+    """JL501 for one compiled module against its traced counts."""
+    from harp_tpu.aot import hlo_audit
+
+    findings: List[Finding] = []
+    for ins in hlo_audit.inserted_collectives(hlo_text, jaxpr_counts):
+        _emit(findings, "JL501", "inserted-collective", target,
+              f"compiler-inserted collective: {ins.count}x {ins.op} "
+              f"({ins.bytes} B, shapes {', '.join(ins.shapes)}) in the "
+              f"compiled module but NO traced primitive of {target!r} "
+              f"lowers to {ins.op} — the SPMD partitioner added this "
+              f"communication after tracing (inferred cause: {ins.cause}); "
+              f"every jaxpr-level budget is blind to it. Re-shard the "
+              f"operands so the trace owns the transfer, or justify it in "
+              f"the allowlist")
+    return findings
+
+
+def replicated_findings_from(hlo_text: str, args,
+                             target: str) -> List[Finding]:
+    """JL503 for one compiled module against its declared arg shardings."""
+    from harp_tpu.aot import hlo_audit
+
+    findings: List[Finding] = []
+    for r in hlo_audit.replicated_where_sharded(hlo_text, args):
+        gdims = ",".join(str(d) for d in r.global_shape)
+        sdims = ",".join(str(d) for d in r.declared_shard)
+        _emit(findings, "JL503", "sharding-propagation", target,
+              f"operand {r.dtype}[{gdims}] declared sharded (per-device "
+              f"block {r.dtype}[{sdims}]) but the partitioner compiled it "
+              f"REPLICATED at its global shape — every device holds all "
+              f"{r.nbytes} B (the static signature of a silent full "
+              f"broadcast; an inserted all-gather usually rides the "
+              f"wire). Fix the sharding annotation/propagation, or "
+              f"justify it in the allowlist")
+    return findings
+
+
+def hazard_findings(name: str, gang: bool = False) -> List[Finding]:
+    """JL501 + JL503 for one registry target (cached trace + lowering)."""
+    from tools.jaxlint import checkers_jaxpr
+
+    closed, args, _link = checkers_jaxpr.traced_target(name, gang=gang)
+    text = lowered_target_text(name, gang=gang)
+    return (inserted_findings_from(text, _jaxpr_collective_counts(closed),
+                                   name)
+            + replicated_findings_from(text, args, name))
+
+
+# -- registry-wide rows ------------------------------------------------------
+
+
+def trace_hlo_all() -> Dict[str, dict]:
+    """JL502 rows for EVERY target in both registries, keyed by target
+    name — compilation only, reusing the shared trace cache."""
+    from tools.jaxlint import trace_targets
+
+    trace_targets.ensure_cpu_mesh()
+    from harp_tpu.aot import hlo_audit
+
+    rows: Dict[str, dict] = {}
+    for name in sorted(trace_targets.TARGETS):
+        rows[name] = hlo_audit.hlo_row(lowered_target_text(name))
+    for name in sorted(trace_targets.GANG_TARGETS):
+        rows[name] = hlo_audit.hlo_row(lowered_target_text(name, gang=True))
+    return rows
+
+
+def check_hlo_hazards() -> List[Finding]:
+    """JL501/JL503 over both registries (raw — the caller routes these
+    through the JL5xx allowlist pool; the JL502/JL504 manifest drift is
+    never suppressible)."""
+    from tools.jaxlint import trace_targets
+
+    trace_targets.ensure_cpu_mesh()
+    findings: List[Finding] = []
+    for name in sorted(trace_targets.TARGETS):
+        findings.extend(hazard_findings(name))
+    for name in sorted(trace_targets.GANG_TARGETS):
+        findings.extend(hazard_findings(name, gang=True))
+    return findings
+
+
+# -- JL504: the serving-dispatch device-kind matrix --------------------------
+
+
+def running_device_kind() -> str:
+    from harp_tpu.aot.store import device_kind
+
+    return device_kind()
+
+
+def serving_dispatch_rows() -> Dict[str, dict]:
+    """The 6 pinned serving dispatches (the artifact-manifest registry:
+    every bucket of the deterministic ``mf``/``nn`` fleet endpoints)
+    lowered on the RUNNING backend → ``{dispatch_name: hlo_row}``."""
+    from tools.jaxlint import trace_targets
+
+    trace_targets.ensure_cpu_mesh()
+    from harp_tpu.aot import hlo_audit
+    from harp_tpu.aot import manifest as aot_manifest
+    from harp_tpu.aot import serve_artifacts
+    from harp_tpu.serve import fleet as fleet_mod
+
+    if not _DISPATCH_CACHE:
+        sess = aot_manifest._session()
+        for model, mspec in sorted(aot_manifest.SERVE_MODELS.items()):
+            ep = fleet_mod.build_endpoint(sess, model, mspec)
+            for bucket in ep.bucket_sizes:
+                name = serve_artifacts.dispatch_name(model, bucket)
+                _DISPATCH_CACHE[name] = hlo_audit.lower_fn_text(
+                    ep.compiled(bucket), ep.dispatch_args(bucket))
+    from harp_tpu.aot.hlo_audit import hlo_row
+
+    return {name: hlo_row(text)
+            for name, text in sorted(_DISPATCH_CACHE.items())}
+
+
+# -- manifest (the `hlo` section) -------------------------------------------
+
+
+def load_hlo_section(repo_root: str) -> Optional[dict]:
+    path = os.path.join(repo_root, BUDGET_FILE)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.load(f).get("hlo")
+
+
+def build_hlo_section(repo_root: str) -> dict:
+    """The full ``hlo`` manifest section for a regenerate: fresh rows for
+    both registries, the running kind's dispatch matrix, and CARRY-FORWARD
+    of every pinned device-kind matrix this process cannot re-lower (a CPU
+    regenerate must not drop the TPU rows a TPU run pinned)."""
+    import jax
+
+    rows = trace_hlo_all()
+    pinned = load_hlo_section(repo_root) or {}
+    kinds = {k: dict(v)
+             for k, v in (pinned.get("device_kinds") or {}).items()}
+    kinds[running_device_kind()] = serving_dispatch_rows()
+    return {
+        "lowered_with_jax": jax.__version__,
+        "targets": rows,
+        "device_kinds": {k: kinds[k] for k in sorted(kinds)},
+    }
+
+
+def _diff_row(traced: dict, pinned: dict) -> List[str]:
+    drift = []
+    for field in HLO_FIELDS:
+        got, want = traced.get(field), pinned.get(field)
+        if got != want:
+            drift.append(f"{field}: lowered {got} vs pinned {want}")
+    return drift
+
+
+def check_hlo_budget(repo_root: str,
+                     rows: Optional[Dict[str, dict]] = None,
+                     kind_rows: Optional[Dict[str, dict]] = None,
+                     ) -> List[Finding]:
+    """JL502 (per-target compiled rows) + JL504 (device-kind dispatch
+    matrix) vs the manifest's ``hlo`` section — exact equality,
+    stale/missing loud, env mismatch ONE re-pin finding."""
+    import jax
+
+    findings: List[Finding] = []
+    pinned = load_hlo_section(repo_root)
+    if pinned is None:
+        _emit(findings, "JL502", "hlo-budget", "<manifest>",
+              f"{BUDGET_FILE} has no hlo section — the compiled-collective "
+              f"contract is unpinned; regenerate with `python -m "
+              f"tools.jaxlint --update-budget` and commit the hlo rows")
+        return findings
+    pinned_jax = pinned.get("lowered_with_jax")
+    if pinned_jax != jax.__version__:
+        # compiled instruction counts are only deterministic per jax/XLA
+        # version — N bogus drifts would bury the one real message
+        _emit(findings, "JL502", "hlo-budget", "<manifest>",
+              f"hlo section was lowered with jax {pinned_jax!r} but this "
+              f"process runs {jax.__version__!r} — compiled rows are "
+              f"version-specific; re-pin with --update-budget on the CI "
+              f"environment")
+        return findings
+    if rows is None:
+        rows = trace_hlo_all()
+    pinned_rows = pinned.get("targets", {})
+    for name, row in sorted(rows.items()):
+        if name not in pinned_rows:
+            _emit(findings, "JL502", "hlo-budget", name,
+                  f"lowered target {name!r} has no hlo row — run "
+                  f"--update-budget and review the new row")
+            continue
+        drift = _diff_row(row, pinned_rows[name])
+        if drift:
+            _emit(findings, "JL502", "hlo-budget", name,
+                  f"compiled-HLO budget drift ({'; '.join(drift)}) — what "
+                  f"the PARTITIONER emits for this program moved (a grown "
+                  f"collective row is wire traffic the jaxpr budget never "
+                  f"saw; a grown instruction/while count is a compiled "
+                  f"program change); if intentional, --update-budget and "
+                  f"review the diff")
+    for name in sorted(set(pinned_rows) - set(rows)):
+        _emit(findings, "JL502", "hlo-budget", name,
+              f"hlo row {name!r} matches no trace target — stale row "
+              f"(target renamed/removed); regenerate with --update-budget")
+
+    # JL504: the running kind's dispatch matrix. Pinned kinds this
+    # process cannot reach (the TPU rows, from a CPU session) are
+    # CARRIED FORWARD — skipped here, preserved by build_hlo_section.
+    if kind_rows is None:
+        kind_rows = serving_dispatch_rows()
+    kind = running_device_kind()
+    pinned_kinds = pinned.get("device_kinds", {})
+    if kind not in pinned_kinds:
+        _emit(findings, "JL504", "device-kind-matrix", f"<{kind}>",
+              f"no pinned serving-dispatch row matrix for the running "
+              f"device kind {kind!r} ({len(kind_rows)} dispatches lower) "
+              f"— run --update-budget on this backend and commit the "
+              f"matrix")
+        return findings
+    pinned_matrix = pinned_kinds[kind]
+    for name, row in sorted(kind_rows.items()):
+        if name not in pinned_matrix:
+            _emit(findings, "JL504", "device-kind-matrix", name,
+                  f"serving dispatch {name!r} has no pinned hlo row under "
+                  f"device kind {kind!r} — run --update-budget and review "
+                  f"the new row")
+            continue
+        drift = _diff_row(row, pinned_matrix[name])
+        if drift:
+            _emit(findings, "JL504", "device-kind-matrix", name,
+                  f"serving dispatch {name!r} lowers differently on "
+                  f"device kind {kind!r} than pinned "
+                  f"({'; '.join(drift)}) — a kind-dependent lowering "
+                  f"regression (the heterogeneous fleet would ship it "
+                  f"blind); if intentional, --update-budget and review "
+                  f"the diff")
+    for name in sorted(set(pinned_matrix) - set(kind_rows)):
+        _emit(findings, "JL504", "device-kind-matrix", name,
+              f"pinned dispatch row {name!r} under device kind {kind!r} "
+              f"matches no serving dispatch — stale row; regenerate with "
+              f"--update-budget")
+    return findings

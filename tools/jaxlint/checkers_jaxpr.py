@@ -327,12 +327,14 @@ def load_budget(repo_root: str) -> Optional[dict]:
         return json.load(f)
 
 
-def write_budget(repo_root: str, traced, gang=None, memory=None) -> str:
+def write_budget(repo_root: str, traced, gang=None, memory=None,
+                 hlo=None) -> str:
     """Rewrite the manifest from ``traced`` (and ``gang``, the gang-mode
     rows from :func:`trace_gang_all`; ``memory``, the static memory rows
-    from ``checkers_memory.trace_memory_all``. None carries the committed
-    rows of that section forward unchanged so a single-engine regenerate
-    can't silently drop another engine's contract)."""
+    from ``checkers_memory.trace_memory_all``; ``hlo``, the compiled-HLO
+    section from ``checkers_hlo.build_hlo_section``. None carries the
+    committed rows of that section forward unchanged so a single-engine
+    regenerate can't silently drop another engine's contract)."""
     import jax
 
     if gang is None:
@@ -348,6 +350,11 @@ def write_budget(repo_root: str, traced, gang=None, memory=None) -> str:
     else:
         memory_rows = {name: dict(row)
                        for name, row in sorted(memory.items())}
+    if hlo is None:
+        existing = load_budget(repo_root) or {}
+        hlo_section = existing.get("hlo", {})
+    else:
+        hlo_section = dict(hlo)
     path = os.path.join(repo_root, BUDGET_FILE)
     doc = {
         "_contract": (
@@ -381,7 +388,20 @@ def write_budget(repo_root: str, traced, gang=None, memory=None) -> str:
             "rounded) per target across BOTH registries — a grown peak is "
             "a memory regression that otherwise ships invisibly until an "
             "OOM on real HBM, and the resident rows are the model mall's "
-            "planning input (JL401)."),
+            "planning input (JL401). hlo pins the POST-SPMD compiled "
+            "contract (ISSUE 20, checkers_hlo/hlo_audit): every target "
+            "lowered through jax.jit(...).lower().compile() — compilation "
+            "only, never execution — with per-target compiler-emitted "
+            "collective counts + result-shape bytes, instruction count, "
+            "and while-body count (JL502; the layer GSPMD is free to "
+            "rewrite AFTER tracing, so a jaxpr-clean program can still "
+            "grow wire traffic only this section sees), plus "
+            "device_kinds: the 6 pinned serving dispatches lowered per "
+            "reachable device kind (JL504 — cpu always; TPU kinds pin "
+            "when lint runs there, and sessions that cannot reach a "
+            "pinned kind carry its matrix forward, never stale). Rows "
+            "are exact per lowered_with_jax version; a different jax "
+            "re-pins with ONE finding."),
         "traced_with_jax": jax.__version__,
         "targets": {
             name: {
@@ -393,6 +413,7 @@ def write_budget(repo_root: str, traced, gang=None, memory=None) -> str:
             for name, (counts, _bad, nbytes) in sorted(traced.items())},
         "gang_targets": gang_rows,
         "memory": memory_rows,
+        "hlo": hlo_section,
     }
     with open(path, "w", encoding="utf-8") as f:
         json.dump(doc, f, indent=2, sort_keys=False)

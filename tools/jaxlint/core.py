@@ -138,6 +138,33 @@ def validate_allowlist(allowlist: Allowlist) -> List[str]:
     return errors
 
 
+# one allowlist file, one pool PER ENGINE: traced findings of the memory
+# engine (JL4xx) and the lowered-HLO engine (JL5xx) key on the budget file
+# + target name, everything else keys on source locations the AST engines
+# own. Each pass applies ONLY its pool — a cross-engine entry must never
+# report stale just because the pass that owns it didn't run.
+ENGINE_CODE_PREFIXES = {"memory": ("JL4",), "hlo": ("JL5",)}
+
+
+def split_allowlist(allowlist: Allowlist) -> Dict[str, Allowlist]:
+    """``{"ast": ..., "memory": ..., "hlo": ...}`` — a disjoint,
+    exhaustive partition of the allowlist by owning engine (malformed keys
+    land in the ast pool, where validate_allowlist already reports
+    them)."""
+    pools: Dict[str, Allowlist] = {name: {}
+                                   for name in ("ast", *ENGINE_CODE_PREFIXES)}
+    for key, why in allowlist.items():
+        code = key[2] if (isinstance(key, tuple) and len(key) == 3
+                          and isinstance(key[2], str)) else ""
+        for engine, prefixes in ENGINE_CODE_PREFIXES.items():
+            if code.startswith(prefixes):
+                pools[engine][key] = why
+                break
+        else:
+            pools["ast"][key] = why
+    return pools
+
+
 def apply_allowlist(raw: List[Finding], allowlist: Allowlist,
                     ) -> Tuple[List[Finding], List[str]]:
     """Split raw findings into (active, stale-entry errors).
